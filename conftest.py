@@ -1,0 +1,21 @@
+"""Pytest root conftest: force an 8-device virtual CPU mesh.
+
+Must run before any JAX backend initializes. The container's
+``sitecustomize`` registers the axon TPU plugin and programmatically sets
+``jax_platforms='axon,cpu'`` at interpreter startup, so overriding the
+environment variable alone is not enough — we also update the config.
+Tests then see ``jax.local_device_count() == 8`` on CPU, the standard
+fake-mesh trick for exercising multi-chip sharding without hardware.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,28 @@
+"""Importable alias for the TPU-native framework package.
+
+The implementation lives in
+``non-iid-distributed-learning-with-optimal-mixture-weights_tpu/`` (the
+canonical project directory name), which is not a valid Python
+identifier. Importing ``fedamw_tpu`` loads that package under this name,
+so ``import fedamw_tpu.algorithms`` etc. work everywhere.
+"""
+
+import importlib.util
+import os
+import sys
+
+_PKG_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "non-iid-distributed-learning-with-optimal-mixture-weights_tpu",
+)
+
+_spec = importlib.util.spec_from_file_location(
+    "fedamw_tpu",
+    os.path.join(_PKG_DIR, "__init__.py"),
+    submodule_search_locations=[_PKG_DIR],
+)
+_mod = importlib.util.module_from_spec(_spec)
+# Replace this shim in sys.modules with the real package *before* exec so
+# intra-package relative imports resolve against the package.
+sys.modules["fedamw_tpu"] = _mod
+_spec.loader.exec_module(_mod)

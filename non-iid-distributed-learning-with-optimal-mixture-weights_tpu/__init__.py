@@ -1,0 +1,35 @@
+"""TPU-native federated-learning framework with optimal mixture weights.
+
+A ground-up JAX/XLA re-design of the capabilities of
+``Bojian-Wei/Non-IID-Distributed-Learning-with-Optimal-Mixture-Weights``
+(ECML-PKDD 2022): kernel-approximated (RFF) linear models trained over
+simulated non-IID clients with six federated algorithms — Centralized,
+Distributed (one-shot), FedAvg, FedProx, FedNova, and the paper's FedAMW
+(server-side mixture weights ``p`` learned by SGD on a pooled validation
+set) plus its one-shot variant.
+
+TPU-first architecture (nothing here is a port of the reference's
+torch loops — see SURVEY.md §7):
+
+- clients are a *leading array axis*, not Python list entries: one dense
+  feature matrix lives in HBM once and every client is an int32 index set
+  into it (``data/pack.py``);
+- the per-client local-SGD loop (reference ``functions/tools.py:177-215``)
+  is a pure jitted kernel — ``lax.scan`` over epochs/minibatches,
+  ``jax.vmap`` over the client axis (``fedcore/client.py``);
+- server aggregation (reference ``functions/tools.py:345-349``) is a
+  weighted ``einsum`` over stacked parameter pytrees, and the FedAMW
+  mixture-weight solver (``functions/tools.py:441-453``) becomes a jitted
+  reduction over precomputed per-client validation logits
+  (``fedcore/aggregate.py``);
+- scale-out is client-axis data parallelism over a ``jax.sharding.Mesh``
+  (``parallel/mesh.py``) — the aggregation einsum turns into an ICI
+  ``psum`` under jit; no NCCL/MPI analog exists or is needed.
+
+Import via the repo-root alias module ``fedamw_tpu`` (this directory name
+is not a valid Python identifier).
+"""
+
+from . import config  # noqa: F401
+
+__version__ = "0.1.0"

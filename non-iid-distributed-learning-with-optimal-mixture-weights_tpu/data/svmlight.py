@@ -1,0 +1,80 @@
+"""LIBSVM/svmlight data loading and label canonicalization.
+
+Replicates the data semantics of the reference's ``svmlight_data`` Dataset
+(``functions/utils.py:36-65``) without torch: features densified to
+float32, labels canonicalized by task type. A native C++ parser (see
+``native/``) is used when built; otherwise sklearn's parser.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..config import REGRESSION_DATASETS
+
+
+def is_regression(dataset_name: str) -> bool:
+    """Name-list check, reference ``functions/utils.py:32-34``.
+
+    Test-split files are named ``{name}.t``; the suffix is stripped so
+    e.g. ``cadata.t`` canonicalizes as regression like its train split
+    (the torch reference misses this and mangles regression test labels).
+    """
+    if dataset_name.endswith(".t"):
+        dataset_name = dataset_name[:-2]
+    return dataset_name in REGRESSION_DATASETS
+
+
+def canonicalize_labels(y: np.ndarray, dataset_name: str) -> np.ndarray:
+    """Label canonicalization, reference ``functions/utils.py:39-45``.
+
+    - regression datasets: min-max scaled to [0, 100], float32;
+    - binary: min-max to {0, 1} (e.g. a9a's {-1,+1} -> {0,1}), int32;
+    - multiclass: shifted so the smallest label is 0, int32.
+    """
+    y = np.asarray(y)
+    if is_regression(dataset_name):
+        return (100.0 * (y - y.min()) / (y.max() - y.min())).astype(np.float32)
+    n_distinct = len(np.unique(y))
+    if n_distinct == 2:
+        y = (y - y.min()) / (y.max() - y.min())
+    elif n_distinct > 2:
+        y = y - y.min()
+    return np.rint(y).astype(np.int32)
+
+
+def _parse_with_sklearn(path: str):
+    from sklearn.datasets import load_svmlight_file
+
+    X, y = load_svmlight_file(path)
+    return np.asarray(X.todense(), dtype=np.float32), np.asarray(y)
+
+
+def _parse_with_native(path: str):
+    from .. import native_io
+
+    return native_io.load_svmlight(path)
+
+
+def load_svmlight(
+    dataset_name: str, data_dir: str = "datasets", use_native: bool = True
+):
+    """Load ``{data_dir}/{dataset_name}`` and canonicalize labels.
+
+    Returns ``(X (n, d) float32, y (n,))``. Raises FileNotFoundError if
+    the file is absent (callers decide whether to fall back to synthetic
+    data — this box has no network egress to download LIBSVM sets).
+    """
+    path = os.path.join(data_dir, dataset_name)
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    if use_native:
+        try:
+            X, y = _parse_with_native(path)
+        except (ImportError, OSError):
+            X, y = _parse_with_sklearn(path)
+    else:
+        X, y = _parse_with_sklearn(path)
+    return X, canonicalize_labels(y, dataset_name)

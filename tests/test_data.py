@@ -1,0 +1,123 @@
+import numpy as np
+import pytest
+
+from fedamw_tpu.data import (
+    canonicalize_labels,
+    load_dataset,
+    load_svmlight,
+    pack_partitions,
+    split_train_val,
+    synthetic_classification,
+)
+
+
+class TestCanonicalizeLabels:
+    def test_binary_pm1(self):
+        y = canonicalize_labels(np.array([-1.0, 1.0, -1.0, 1.0]), "a9a")
+        np.testing.assert_array_equal(y, [0, 1, 0, 1])
+        assert y.dtype == np.int32
+
+    def test_binary_12(self):
+        y = canonicalize_labels(np.array([1.0, 2.0, 2.0]), "whatever")
+        np.testing.assert_array_equal(y, [0, 1, 1])
+
+    def test_multiclass_shift(self):
+        y = canonicalize_labels(np.array([1.0, 3.0, 7.0]), "satimage")
+        np.testing.assert_array_equal(y, [0, 2, 6])
+
+    def test_regression_minmax_100(self):
+        y = canonicalize_labels(np.array([2.0, 4.0, 6.0]), "abalone")
+        np.testing.assert_allclose(y, [0.0, 50.0, 100.0])
+        assert y.dtype == np.float32
+
+    def test_regression_test_split_suffix(self):
+        # '.t' files must canonicalize like their train split (the torch
+        # reference mangles regression test labels here).
+        y = canonicalize_labels(np.array([2.0, 4.0, 6.0]), "cadata.t")
+        np.testing.assert_allclose(y, [0.0, 50.0, 100.0])
+        assert y.dtype == np.float32
+
+
+def test_svmlight_roundtrip(tmp_path):
+    path = tmp_path / "toy"
+    path.write_text("3 1:0.5 4:1.5\n1 2:2.0\n2 1:-1.0 4:0.25\n")
+    X, y = load_svmlight("toy", str(tmp_path))
+    assert X.shape == (3, 4)
+    np.testing.assert_allclose(X[0], [0.5, 0, 0, 1.5])
+    np.testing.assert_array_equal(y, [2, 0, 1])  # shifted multiclass
+
+
+class TestPack:
+    def test_shapes_and_mask(self):
+        parts = [np.array([3, 1, 4]), np.array([5]), np.array([9, 2])]
+        pack = pack_partitions(parts)
+        assert pack.idx.shape == (3, 3)
+        np.testing.assert_array_equal(pack.sizes, [3, 1, 2])
+        np.testing.assert_array_equal(pack.mask.sum(axis=1), [3, 1, 2])
+        np.testing.assert_array_equal(pack.idx[1], [5, 0, 0])
+
+    def test_weights(self):
+        pack = pack_partitions([np.arange(3), np.arange(1)])
+        np.testing.assert_allclose(pack.weights, [0.75, 0.25])
+
+    def test_pad_clients(self):
+        pack = pack_partitions([np.arange(3), np.arange(2)], pad_clients_to=4)
+        assert pack.num_clients == 4
+        assert pack.mask[2:].sum() == 0
+        assert pack.weights[2:].sum() == 0
+
+    def test_n_max_too_small(self):
+        with pytest.raises(ValueError):
+            pack_partitions([np.arange(5)], n_max=3)
+
+
+def test_split_train_val_partition():
+    rng = np.random.RandomState(0)
+    parts = [np.arange(0, 40), np.arange(40, 100)]
+    train_parts, val_idx = split_train_val(parts, 0.2, rng)
+    assert len(val_idx) == 8 + 12
+    combined = np.sort(np.concatenate(train_parts + [val_idx]))
+    np.testing.assert_array_equal(combined, np.arange(100))
+    # val comes only from each client's own shard
+    assert set(val_idx[:8]).issubset(set(range(40)))
+
+
+def test_synthetic_classification_signature():
+    X, y, Xt, yt = synthetic_classification(1000, 36, 6, seed=1)
+    assert X.shape == (1000, 36) and Xt.shape == (250, 36)
+    assert set(np.unique(y)).issubset(set(range(6)))
+    # learnable: clusters separate classes better than chance
+    assert X.dtype == np.float32 and y.dtype == np.int32
+
+
+def test_load_dataset_digits():
+    ds = load_dataset("digits", num_partitions=5, alpha=0.5)
+    assert ds.source == "sklearn"
+    assert ds.d == 64 and ds.num_classes == 10
+    assert ds.num_partitions == 5
+    assert min(len(p) for p in ds.parts) >= 10
+    total = sum(len(p) for p in ds.parts)
+    assert total == len(ds.y_train)
+
+
+def test_load_dataset_synthetic_fallback():
+    ds = load_dataset("satimage", num_partitions=4, alpha=1.0)
+    assert ds.source == "synthetic"
+    assert ds.d == 36 and ds.num_classes == 6
+
+
+def test_generate_synthetic_lognormal_sizes():
+    from fedamw_tpu.data import generate_synthetic
+
+    X, y, Xt, yt, dh, mh = generate_synthetic(
+        0.5, 0.5, 4, 0, 3, rng=np.random.RandomState(0)
+    )
+    assert X.shape[0] == 3 and X.shape[2] == 4
+    assert y.shape == X.shape[:2]
+
+
+def test_load_dataset_iid():
+    ds = load_dataset("digits", num_partitions=4, alpha=-1,
+                      rng=np.random.RandomState(5))
+    sizes = [len(p) for p in ds.parts]
+    assert max(sizes) - min(sizes) <= 1

@@ -1,0 +1,90 @@
+import numpy as np
+import pytest
+
+from fedamw_tpu.data import dirichlet_partition, uniform_partition
+
+
+def _reference_transcription(labels, n_parts, alpha, seed):
+    """Direct transcription of the reference partitioner using the global
+    RNG (``functions/utils.py:314-349``), used only to pin exact parity of
+    our RandomState-based implementation."""
+    labels = np.asarray(labels)
+    K = len(set(labels.tolist()))
+    N = len(labels)
+    np.random.seed(seed)
+    min_size = 0
+    while min_size < 10:
+        idx_batch = [[] for _ in range(n_parts)]
+        for k in range(K):
+            idx_k = np.where(labels == k)[0]
+            np.random.shuffle(idx_k)
+            proportions = np.random.dirichlet(np.repeat(alpha, n_parts))
+            proportions = np.array(
+                [p * (len(idx_j) < N / n_parts) for p, idx_j in zip(proportions, idx_batch)]
+            ) + 1 / len(idx_k)
+            proportions = proportions / proportions.sum()
+            proportions = (np.cumsum(proportions) * len(idx_k)).astype(int)[:-1]
+            idx_batch = [
+                idx_j + idx.tolist()
+                for idx_j, idx in zip(idx_batch, np.split(idx_k, proportions))
+            ]
+            min_size = min([len(idx_j) for idx_j in idx_batch])
+    for j in range(n_parts):
+        np.random.shuffle(idx_batch[j])
+    return idx_batch
+
+
+@pytest.fixture
+def labels():
+    rng = np.random.RandomState(3)
+    return rng.randint(0, 6, size=2000)
+
+
+def test_exact_cover(labels):
+    parts, _ = dirichlet_partition(labels, 8, 0.1, seed=2020)
+    all_idx = np.concatenate(parts)
+    assert len(all_idx) == len(labels)
+    assert len(np.unique(all_idx)) == len(labels)
+
+
+def test_min_size_honored(labels):
+    parts, _ = dirichlet_partition(labels, 8, 0.01, seed=2020)
+    assert min(len(p) for p in parts) >= 10
+
+
+def test_deterministic(labels):
+    a, _ = dirichlet_partition(labels, 8, 0.1, seed=2020)
+    b, _ = dirichlet_partition(labels, 8, 0.1, seed=2020)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+@pytest.mark.parametrize("alpha", [0.01, 0.1, 1.0])
+def test_bitwise_parity_with_reference_rng(labels, alpha):
+    ours, _ = dirichlet_partition(labels, 8, alpha, seed=2020)
+    ref = _reference_transcription(labels, 8, alpha, seed=2020)
+    assert len(ours) == len(ref)
+    for o, r in zip(ours, ref):
+        np.testing.assert_array_equal(o, np.asarray(r))
+
+
+def test_class_counts(labels):
+    parts, counts = dirichlet_partition(labels, 4, 0.5, seed=2020)
+    for j, p in enumerate(parts):
+        assert sum(counts[j].values()) == len(p)
+
+
+def test_uniform_partition_covers():
+    parts = uniform_partition(103, 5, np.random.RandomState(0))
+    idx = np.concatenate(parts)
+    assert sorted(idx.tolist()) == list(range(103))
+    assert max(len(p) for p in parts) - min(len(p) for p in parts) <= 1
+
+
+def test_skew_increases_as_alpha_shrinks(labels):
+    def skew(alpha):
+        parts, _ = dirichlet_partition(labels, 8, alpha, seed=2020)
+        sizes = np.array([len(p) for p in parts], float)
+        return sizes.std() / sizes.mean()
+
+    assert skew(0.01) > skew(100.0)
