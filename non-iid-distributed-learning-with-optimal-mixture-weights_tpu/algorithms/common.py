@@ -1,0 +1,144 @@
+"""Shared experiment setup: RFF mapping, val split, packing, placement.
+
+``prepare_setup`` performs the reference drivers' preamble
+(``exp.py:60-99``): load -> RFF-map once with a single draw -> per-client
+80/20 split with the 20% pooled for mixture-weight fitting -> pack the
+clients into the dense index layout. Everything lands on device once;
+algorithms then run entirely jitted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data import FederatedDataset, pack_partitions, split_train_val
+from ..models import Model, get_model
+from ..ops.rff import rff_map, rff_params
+
+
+@dataclasses.dataclass
+class FedSetup:
+    """Device-resident experiment state shared by all algorithms."""
+
+    model: Model
+    task: str
+    num_classes: int
+    D: int                      # feature dim the model sees (post-RFF)
+    X: jax.Array                # (N, D) mapped train features, shared
+    y: jax.Array                # (N,)
+    X_test: jax.Array
+    y_test: jax.Array
+    X_val: jax.Array            # pooled validation (n_val, D)
+    y_val: jax.Array
+    idx: jax.Array              # (J, n_max) client row indices
+    mask: jax.Array             # (J, n_max)
+    sizes: jax.Array            # (J,) true client sizes
+    p_fixed: jax.Array          # (J,) sample-count mixture weights (ClientPack.weights)
+    rff: tuple | None = None    # (W, b) draw, for mapping new data
+
+    @property
+    def num_clients(self) -> int:
+        return int(self.idx.shape[0])
+
+    @property
+    def all_train_idx(self) -> jax.Array:
+        """One flat index set of every valid train row (for Centralized)."""
+        flat = np.asarray(self.idx).reshape(-1)
+        keep = np.asarray(self.mask).reshape(-1) > 0
+        return jnp.asarray(flat[keep], dtype=jnp.int32)
+
+
+def prepare_setup(
+    ds: FederatedDataset,
+    D: int = 2000,
+    kernel_par: float = 0.1,
+    kernel_type: str = "gaussian",
+    val_fraction: float = 0.2,
+    seed: int = 100,
+    model: Model | str = "linear",
+    rng: np.random.RandomState | None = None,
+    pad_clients_to: int | None = None,
+    n_max: int | None = None,
+) -> FedSetup:
+    """Build the device-resident setup from a loaded dataset.
+
+    ``rng`` drives the per-client val split (the reference uses the
+    driver-seeded global NumPy RNG there, ``exp.py:28-29,80-86``);
+    ``seed`` drives the RFF draw via ``jax.random`` (torch's global RNG
+    in the reference — bitwise parity across frameworks is impossible, so
+    parity here is statistical; SURVEY.md §2.3.4).
+    """
+    if rng is None:
+        rng = np.random.RandomState(seed)
+    if isinstance(model, str):
+        model = get_model(model)
+
+    key = jax.random.PRNGKey(seed)
+    X_train = jnp.asarray(ds.X_train)
+    X_test = jnp.asarray(ds.X_test)
+    if kernel_type == "gaussian":
+        W, b = rff_params(key, ds.d, D, kernel_par)
+        X_train = rff_map(X_train, W, b)
+        X_test = rff_map(X_test, W, b)
+        rff = (W, b)
+        feat_dim = D
+    else:
+        rff = None
+        feat_dim = ds.d
+
+    train_parts, val_idx = split_train_val(ds.parts, val_fraction, rng)
+    pack = pack_partitions(train_parts, n_max=n_max, pad_clients_to=pad_clients_to)
+
+    y = jnp.asarray(ds.y_train)
+    return FedSetup(
+        model=model,
+        task=ds.task_type,
+        num_classes=ds.num_classes,
+        D=feat_dim,
+        X=X_train,
+        y=y,
+        X_test=X_test,
+        y_test=jnp.asarray(ds.y_test),
+        X_val=X_train[jnp.asarray(val_idx, dtype=jnp.int32)],
+        y_val=y[jnp.asarray(val_idx, dtype=jnp.int32)],
+        idx=jnp.asarray(pack.idx),
+        mask=jnp.asarray(pack.mask),
+        sizes=jnp.asarray(pack.sizes),
+        p_fixed=jnp.asarray(pack.weights),
+        rff=rff,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class HParams:
+    """Per-algorithm hyperparameters (reference keyword surface)."""
+
+    lr: float = 0.01
+    epochs: int = 2              # local epochs per round
+    batch_size: int = 32
+    rounds: int = 100            # communication rounds
+    mu: float = 0.0              # FedProx coefficient (0 = off)
+    lam: float = 0.0             # ridge coefficient (0 = off)
+    lr_p: float = 5e-5           # mixture-weight lr
+    p_momentum: float = 0.9
+    val_batch_size: int = 16
+    lr_mode: str = "reference"   # see ops/schedule.py
+    sequential: bool = False     # reference client-contamination compat
+    seed: int = 0
+
+    def replace(self, **kw) -> "HParams":
+        return dataclasses.replace(self, **kw)
+
+
+def result_tuple(train_loss, test_loss, test_acc) -> dict[str, Any]:
+    """Uniform result record: numpy copies of the metric vectors."""
+    return {
+        "train_loss": np.asarray(train_loss),
+        "test_loss": np.asarray(test_loss),
+        "test_acc": np.asarray(test_acc),
+    }

@@ -1,0 +1,377 @@
+"""The six federated algorithms + one-shot variant, as jitted round scans.
+
+Reference registry (``functions/tools.py``): ``Centralized`` (:240),
+``Distributed`` (:258), ``FedAMW_OneShot`` (:279), ``FedAvg`` (:329),
+``FedProx`` (:356), ``FedNova`` (:383), ``FedAMW`` (:413). Each keeps the
+reference's keyword surface (``prox``/``mu``, ``lambda_reg_if``/
+``lambda_reg``, ``round``, ``lr_p``) and returns the same
+``(train_loss, test_loss, test_acc)`` shapes.
+
+Design: one communication round = {vmapped local updates -> weighted
+aggregate -> jitted eval}, and the WHOLE training run is a single
+``lax.scan`` over rounds with the learning-rate schedule precomputed as a
+scanned input — one XLA program per algorithm, zero host round-trips
+until the metric vectors come back.
+
+Deliberate divergences from the reference (SURVEY.md §2.3, all
+documented and switchable where meaningful):
+- clients run in parallel from the round's global params by default
+  (``sequential=True`` restores the reference's contamination artifact);
+- the one-shot re-aggregation does NOT mutate client 0's stored weights
+  (the reference's ``p[0]^t`` aliasing bug, ``tools.py:318-322``, is
+  never reproduced);
+- mixture weights are learned unconstrained, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..fedcore import (
+    client_logits,
+    fednova_effective_weights,
+    make_client_round,
+    make_evaluator,
+    make_local_update,
+    make_p_solver,
+    weighted_average,
+)
+from ..ops.schedule import lr_schedule_array
+from .common import FedSetup, result_tuple
+
+
+def _keys(seed: int, *shape):
+    return jax.random.split(jax.random.PRNGKey(seed), shape)
+
+
+def _init_params(setup: FedSetup, seed: int):
+    return setup.model.init(
+        jax.random.fold_in(jax.random.PRNGKey(seed), 7), setup.D, setup.num_classes
+    )
+
+
+def Centralized(
+    setup: FedSetup,
+    lr=0.01,
+    epoch=200,
+    batch_size=32,
+    seed=0,
+    **_,
+):
+    """Upper-bound baseline: all shards pooled, one long local run
+    (reference ``tools.py:240-255``; called with epoch*Round epochs)."""
+    all_idx = setup.all_train_idx
+    n = int(all_idx.shape[0])
+    lu = jax.jit(
+        make_local_update(setup.model.apply, setup.task, epoch, batch_size, n)
+    )
+    params = _init_params(setup, seed)
+    params, train_loss, _ = lu(
+        params,
+        setup.X,
+        setup.y,
+        all_idx,
+        jnp.ones(n, jnp.float32),
+        jax.random.PRNGKey(seed),
+        jnp.float32(lr),
+        jnp.float32(0.0),
+        jnp.float32(0.0),
+    )
+    evaluate = make_evaluator(setup.model.apply, setup.task)
+    test_loss, test_acc = evaluate(params, setup.X_test, setup.y_test)
+    return result_tuple(train_loss, test_loss, test_acc)
+
+
+def _one_shot_local_phase(setup, lr, epoch, batch_size, mu, lam, seed):
+    """Shared by Distributed and FedAMW_OneShot: every client trains
+    epoch*Round epochs from the same init, once."""
+    n_max = int(setup.idx.shape[1])
+    round_fn = jax.jit(
+        make_client_round(
+            setup.model.apply, setup.task, epoch, batch_size, n_max
+        )
+    )
+    params = _init_params(setup, seed)
+    keys = _keys(seed, setup.num_clients)
+    stacked, losses, accs = round_fn(
+        params,
+        setup.X,
+        setup.y,
+        setup.idx,
+        setup.mask,
+        keys,
+        jnp.float32(lr),
+        jnp.float32(mu),
+        jnp.float32(lam),
+    )
+    return stacked, losses
+
+
+def Distributed(
+    setup: FedSetup,
+    lr=0.01,
+    epoch=200,
+    batch_size=32,
+    prox=False,
+    mu=0.1,
+    lambda_reg_if=False,
+    lambda_reg=0.01,
+    seed=0,
+    **_,
+):
+    """One-shot FL with fixed sample-count weights (``tools.py:258-276``)."""
+    stacked, losses = _one_shot_local_phase(
+        setup, lr, epoch, batch_size,
+        mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0, seed,
+    )
+    p = setup.p_fixed
+    train_loss = jnp.sum(p * losses)
+    global_params = weighted_average(stacked, p)
+    evaluate = make_evaluator(setup.model.apply, setup.task)
+    test_loss, test_acc = evaluate(global_params, setup.X_test, setup.y_test)
+    return result_tuple(train_loss, test_loss, test_acc)
+
+
+def FedAMW_OneShot(
+    setup: FedSetup,
+    lr=0.01,
+    epoch=200,
+    batch_size=32,
+    prox=False,
+    mu=0.1,
+    lambda_reg_if=True,
+    lambda_reg=0.01,
+    round=100,
+    lr_p=5e-5,
+    val_batch_size=16,
+    seed=0,
+    **_,
+):
+    """One long local phase, then ``round`` iterations of mixture-weight
+    SGD (plain, no momentum — ``tools.py:301``), re-aggregating and
+    evaluating after each (``tools.py:279-326``). The reference's
+    client-0 aliasing bug (weights rescaled by p[0] every iteration) is
+    deliberately not reproduced."""
+    stacked, losses = _one_shot_local_phase(
+        setup, lr, epoch, batch_size,
+        mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0, seed,
+    )
+    p0 = setup.p_fixed
+    train_loss = jnp.sum(p0 * losses)
+
+    n_val = int(setup.X_val.shape[0])
+    solve, init_opt = make_p_solver(
+        setup.task, n_val, val_batch_size, lr_p, momentum=0.0
+    )
+    evaluate = make_evaluator(setup.model.apply, setup.task)
+    logits = client_logits(setup.model.apply, stacked, setup.X_val)
+    pkeys = _keys(seed + 1, round)
+
+    @jax.jit
+    def p_phase(p, opt_state):
+        def body(carry, key_t):
+            p, opt_state = carry
+            p, opt_state, _, _ = solve(
+                logits, setup.y_val, p, opt_state, key_t, 1
+            )
+            g = weighted_average(stacked, p)
+            tl, ta = evaluate(g, setup.X_test, setup.y_test)
+            return (p, opt_state), (tl, ta)
+
+        (p, opt_state), (tls, tas) = jax.lax.scan(
+            body, (p, opt_state), pkeys
+        )
+        return p, tls, tas
+
+    _, test_loss, test_acc = p_phase(p0, init_opt(p0))
+    return result_tuple(train_loss, test_loss, test_acc)
+
+
+def _round_based(
+    setup: FedSetup,
+    aggregation: str,
+    lr,
+    epoch,
+    batch_size,
+    rounds,
+    mu,
+    lam,
+    lr_p=5e-5,
+    val_batch_size=16,
+    seed=0,
+    lr_mode="reference",
+    sequential=False,
+):
+    """Common skeleton of FedAvg/FedProx/FedNova/FedAMW: scan over rounds
+    of {local updates -> aggregate -> eval} (``tools.py:337-352``)."""
+    n_max = int(setup.idx.shape[1])
+    round_fn = make_client_round(
+        setup.model.apply, setup.task, epoch, batch_size, n_max,
+        sequential=sequential,
+    )
+    evaluate = make_evaluator(setup.model.apply, setup.task)
+    lrs = jnp.asarray(lr_schedule_array(lr, rounds, lr_mode))
+    keys = _keys(seed, rounds, setup.num_clients)
+    params0 = _init_params(setup, seed)
+    p_fixed = setup.p_fixed
+    mu = jnp.float32(mu)
+    lam = jnp.float32(lam)
+
+    if aggregation == "nova":
+        agg_w = fednova_effective_weights(
+            setup.sizes, p_fixed, epoch, batch_size
+        )
+    else:
+        agg_w = p_fixed
+
+    if aggregation == "learned":
+        n_val = int(setup.X_val.shape[0])
+        solve, init_opt = make_p_solver(
+            setup.task, n_val, val_batch_size, lr_p, momentum=0.9
+        )
+        pkeys = _keys(seed + 1, rounds)
+
+        @jax.jit
+        def train(params, p, opt_state):
+            def body(carry, inp):
+                params, p, opt_state = carry
+                lr_t, keys_t, pkey_t = inp
+                stacked, losses, _ = round_fn(
+                    params, setup.X, setup.y, setup.idx, setup.mask,
+                    keys_t, lr_t, mu, lam,
+                )
+                train_loss_t = jnp.sum(p * losses)  # current p (tools.py:434)
+                logits = client_logits(setup.model.apply, stacked, setup.X_val)
+                p, opt_state, _, _ = solve(
+                    logits, setup.y_val, p, opt_state, pkey_t, rounds
+                )
+                params = weighted_average(stacked, p)
+                tl, ta = evaluate(params, setup.X_test, setup.y_test)
+                return (params, p, opt_state), (train_loss_t, tl, ta)
+
+            (params, p, opt_state), metrics = jax.lax.scan(
+                body, (params, p, opt_state), (lrs, keys, pkeys)
+            )
+            return metrics
+
+        metrics = train(params0, p_fixed, init_opt(p_fixed))
+    else:
+
+        @jax.jit
+        def train(params):
+            def body(params, inp):
+                lr_t, keys_t = inp
+                stacked, losses, _ = round_fn(
+                    params, setup.X, setup.y, setup.idx, setup.mask,
+                    keys_t, lr_t, mu, lam,
+                )
+                train_loss_t = jnp.sum(p_fixed * losses)
+                params = weighted_average(stacked, agg_w)
+                tl, ta = evaluate(params, setup.X_test, setup.y_test)
+                return params, (train_loss_t, tl, ta)
+
+            _, metrics = jax.lax.scan(body, params, (lrs, keys))
+            return metrics
+
+        metrics = train(params0)
+
+    return result_tuple(*metrics)
+
+
+def FedAvg(
+    setup: FedSetup,
+    lr=0.01,
+    epoch=2,
+    batch_size=32,
+    prox=False,
+    mu=0.1,
+    lambda_reg_if=False,
+    lambda_reg=0.01,
+    round=100,
+    seed=0,
+    lr_mode="reference",
+    sequential=False,
+    **_,
+):
+    """Standard FedAvg (``tools.py:329-353``)."""
+    return _round_based(
+        setup, "fixed", lr, epoch, batch_size, round,
+        mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
+        seed=seed, lr_mode=lr_mode, sequential=sequential,
+    )
+
+
+def FedProx(
+    setup: FedSetup,
+    lr=0.01,
+    epoch=2,
+    batch_size=32,
+    prox=True,
+    mu=0.1,
+    lambda_reg_if=False,
+    lambda_reg=0.01,
+    round=100,
+    seed=0,
+    lr_mode="reference",
+    sequential=False,
+    **_,
+):
+    """FedAvg skeleton + proximal term (``tools.py:356-380``)."""
+    return _round_based(
+        setup, "fixed", lr, epoch, batch_size, round,
+        mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
+        seed=seed, lr_mode=lr_mode, sequential=sequential,
+    )
+
+
+def FedNova(
+    setup: FedSetup,
+    lr=0.01,
+    epoch=2,
+    batch_size=32,
+    prox=False,
+    mu=0.1,
+    lambda_reg_if=False,
+    lambda_reg=0.01,
+    round=100,
+    seed=0,
+    lr_mode="reference",
+    sequential=False,
+    **_,
+):
+    """Normalized averaging (``tools.py:383-410``)."""
+    return _round_based(
+        setup, "nova", lr, epoch, batch_size, round,
+        mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
+        seed=seed, lr_mode=lr_mode, sequential=sequential,
+    )
+
+
+def FedAMW(
+    setup: FedSetup,
+    lr=0.01,
+    epoch=2,
+    batch_size=32,
+    prox=False,
+    mu=0.1,
+    lambda_reg_if=True,
+    lambda_reg=0.01,
+    round=100,
+    lr_p=5e-5,
+    val_batch_size=16,
+    seed=0,
+    lr_mode="reference",
+    sequential=False,
+    **_,
+):
+    """The paper's algorithm (``tools.py:413-463``): ridge-regularized
+    local training; per round, ``round`` epochs of mixture-weight SGD
+    (momentum 0.9) on the pooled validation set over cached per-client
+    logits; aggregate with the learned, unconstrained p."""
+    return _round_based(
+        setup, "learned", lr, epoch, batch_size, round,
+        mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
+        lr_p=lr_p, val_batch_size=val_batch_size,
+        seed=seed, lr_mode=lr_mode, sequential=sequential,
+    )

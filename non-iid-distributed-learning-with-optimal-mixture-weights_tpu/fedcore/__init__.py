@@ -1,0 +1,18 @@
+from .aggregate import (
+    client_logits,
+    fednova_effective_weights,
+    make_p_solver,
+    weighted_average,
+)
+from .client import make_client_round, make_local_update
+from .evaluate import make_evaluator
+
+__all__ = [
+    "client_logits",
+    "fednova_effective_weights",
+    "make_p_solver",
+    "weighted_average",
+    "make_client_round",
+    "make_local_update",
+    "make_evaluator",
+]

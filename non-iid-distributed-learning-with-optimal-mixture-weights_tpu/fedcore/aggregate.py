@@ -1,0 +1,136 @@
+"""Server-side aggregation: weighted pytree reductions and the FedAMW
+mixture-weight solver.
+
+The reference's per-key Python dict loops (``functions/tools.py:345-349``,
+``388-405``) become weighted ``tensordot`` reductions over stacked
+parameter pytrees with a leading client axis — under a sharded client
+axis this contraction is exactly the ICI ``psum`` the "communication
+backend" needs; no explicit collective code required.
+
+The FedAMW p-solver (``tools.py:441-453``) gets the key TPU redesign:
+the client models are FIXED during the inner loop, so the per-client
+validation logits are computed ONCE per round (one batched einsum on the
+MXU) and the ``round x |val|/16`` tiny SGD steps on ``p`` reduce over
+that cached ``(n_val, J, C)`` tensor — the reference recomputes the full
+``W @ x`` product for every 16-sample batch. Mixture weights stay
+UNCONSTRAINED (no simplex projection), as in the reference
+(``tools.py:417-423``; SURVEY.md §2.3.5).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def weighted_average(stacked_params, p: jax.Array):
+    """``sum_j p_j * theta_j`` over the leading client axis of every leaf.
+
+    Reference ``tools.py:345-349`` (and ``269-273``, ``318-322``,
+    ``455-459``) without the aliasing hazards of its in-place dict loop.
+    """
+    return jax.tree.map(
+        lambda w: jnp.tensordot(p, w, axes=(0, 0)), stacked_params
+    )
+
+
+def fednova_effective_weights(
+    sizes: jax.Array, p: jax.Array, epochs: int, batch_size: int
+) -> jax.Array:
+    """FedNova normalized-averaging weights (reference ``tools.py:388-405``).
+
+    ``tau_j = n_j * epochs / batch_size`` (float, the reference's exact
+    expression — not the true step count ``ceil(n_j/B) * epochs``),
+    ``tau_eff = sum_j tau_j p_j``; effective weight ``p_j tau_eff / tau_j``.
+    """
+    tau = sizes.astype(jnp.float32) * epochs / batch_size
+    tau_eff = jnp.sum(tau * p)
+    # Padded (empty) clients have tau=0 AND p=0; they must stay inert
+    # rather than poison the aggregate with 0/0.
+    safe_tau = jnp.where(tau > 0, tau, 1.0)
+    return jnp.where(tau > 0, p * tau_eff / safe_tau, 0.0)
+
+
+def client_logits(apply_fn: Callable, stacked_params, X: jax.Array) -> jax.Array:
+    """Per-client predictions on a shared matrix: ``(J, n, C) -> (n, J, C)``.
+
+    For the linear model this is the reference's
+    ``matmul(W.permute(2,0,1), data.T)`` (``tools.py:448``) for the whole
+    validation set at once; generic over model pytrees via vmap.
+    """
+    preds = jax.vmap(lambda pj: apply_fn(pj, X))(stacked_params)
+    return jnp.transpose(preds, (1, 0, 2))
+
+
+def make_p_solver(
+    task: str,
+    n_val: int,
+    batch_size: int = 16,
+    lr_p: float = 1e-3,
+    momentum: float = 0.0,
+):
+    """Build the jitted mixture-weight SGD solver.
+
+    Returns ``(solve, init_opt_state)`` where
+    ``solve(logits (n_val,J,C), y_val (n_val,), p (J,), opt_state, key,
+    num_epochs) -> (p, opt_state, last_epoch_loss, last_epoch_acc)``
+    runs ``num_epochs`` full passes over the pooled validation set in
+    shuffled batches of ``batch_size`` (reference: DataLoader(16,
+    shuffle=True), ``exp.py:99``), stepping ``p`` per batch with
+    SGD(momentum) — torch-identical update rule via optax.
+
+    ``num_epochs`` is static (it sets the scan length); FedAMW passes the
+    communication-round count, the one-shot variant passes 1.
+    """
+    from ..ops.losses import ce_per_example, masked_mean, mse_per_example
+    from ..ops.metrics import top1_correct
+    from .batching import epoch_batches, weighted_epoch_metrics
+
+    tx = optax.sgd(lr_p, momentum=momentum if momentum > 0 else None)
+
+    def init_opt_state(p):
+        return tx.init(p)
+
+    def batch_loss(p, logits_b, y_b, valid_b):
+        out = jnp.einsum("bjc,j->bc", logits_b, p)
+        if task == "classification":
+            per = ce_per_example(out, y_b)
+        else:
+            per = mse_per_example(out, y_b)
+        return masked_mean(per, valid_b), out
+
+    grad_fn = jax.value_and_grad(batch_loss, has_aux=True)
+
+    def solve(logits, y_val, p, opt_state, key, num_epochs: int):
+        def epoch_body(carry, key_e):
+            p, opt_state = carry
+            b_idx, b_valid = epoch_batches(key_e, n_val, batch_size)
+
+            def step(carry, inp):
+                p, opt_state = carry
+                rows, bv = inp
+                (loss, out), g = grad_fn(p, logits[rows], y_val[rows], bv)
+                updates, opt_state = tx.update(g, opt_state, p)
+                p = optax.apply_updates(p, updates)
+                cnt = jnp.sum(bv)
+                if task == "classification":
+                    correct = jnp.sum(top1_correct(out, y_val[rows]) * bv)
+                else:
+                    correct = jnp.float32(0.0)
+                return (p, opt_state), (loss * cnt, correct, cnt)
+
+            (p, opt_state), (losses, corrects, cnts) = jax.lax.scan(
+                step, (p, opt_state), (b_idx, b_valid)
+            )
+            return (p, opt_state), weighted_epoch_metrics(losses, corrects, cnts)
+
+        keys = jax.random.split(key, num_epochs)
+        (p, opt_state), (ep_losses, ep_accs) = jax.lax.scan(
+            epoch_body, (p, opt_state), keys
+        )
+        return p, opt_state, ep_losses[-1], ep_accs[-1]
+
+    return solve, init_opt_state

@@ -1,0 +1,60 @@
+"""Shared minibatch machinery for scanned epochs.
+
+Both the client-update kernel and the mixture-weight solver iterate
+"shuffle -> fixed-count batches -> batch-size-weighted epoch metrics"
+(torch ``DataLoader(shuffle=True)`` semantics with the last partial batch
+kept, reference ``tools.py:178-179`` / ``exp.py:99``). This module is the
+single implementation of that masked, static-shape batching.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def batch_counts(n: int, batch_size: int) -> tuple[int, int]:
+    """(num_batches, pad) for n samples in batches of batch_size."""
+    num_batches = max(1, math.ceil(n / batch_size))
+    return num_batches, num_batches * batch_size - n
+
+
+def epoch_batches(
+    key: jax.Array,
+    n: int,
+    batch_size: int,
+    mask: jax.Array | None = None,
+):
+    """One shuffled epoch as static-shape batches.
+
+    Returns ``(positions, valid)`` of shape ``(num_batches, batch_size)``:
+    ``positions`` index into the 0..n-1 sample axis (real samples in
+    random order first, padding after), ``valid`` flags which slots hold
+    real samples. With a ``mask``, masked-out rows sort to the back and
+    are never valid.
+    """
+    num_batches, pad = batch_counts(n, batch_size)
+    if mask is None:
+        perm = jax.random.permutation(key, n)
+        valid = jnp.ones(n, jnp.float32)
+    else:
+        r = jax.random.uniform(key, (n,))
+        perm = jnp.argsort(r + (1.0 - mask) * 2.0)
+        valid = mask[perm]
+    if pad:
+        perm = jnp.concatenate([perm, jnp.zeros(pad, perm.dtype)])
+        valid = jnp.concatenate([valid, jnp.zeros(pad, valid.dtype)])
+    return (
+        perm.reshape(num_batches, batch_size),
+        valid.reshape(num_batches, batch_size),
+    )
+
+
+def weighted_epoch_metrics(losses, corrects, cnts):
+    """Meter-style epoch averages: per-batch values weighted by batch
+    valid-counts (reference ``tools.py:212-213``). Returns
+    ``(avg_loss, acc_percent)``."""
+    total = jnp.maximum(jnp.sum(cnts), 1.0)
+    return jnp.sum(losses) / total, 100.0 * jnp.sum(corrects) / total

@@ -1,0 +1,144 @@
+"""The client-update kernel: local SGD as a pure, vmappable function.
+
+This is the TPU-native replacement for the reference's ``train_loop``
+(``functions/tools.py:177-215``) and the sequential client loop around it
+(``tools.py:340-343``). One pure function runs a client's full local
+training — ``lax.scan`` over epochs, ``lax.scan`` over shuffled masked
+minibatches — and ``jax.vmap`` lifts it over the client axis, so a round
+of J clients is a single fused XLA computation instead of J Python
+iterations. Data never moves: clients hold int32 row indices into the
+shared ``(N, D)`` feature matrix and batches are HBM gathers.
+
+Reference semantics kept exactly (SURVEY.md §2.3):
+- the prox anchor is the client's *incoming* parameters (the reference
+  deep-copies the passed model, ``tools.py:180``);
+- minibatches are a fresh shuffle each epoch, last partial batch kept
+  (torch DataLoader(shuffle=True) defaults);
+- the returned loss/accuracy are the LAST epoch's batch-size-weighted
+  averages, with penalty terms included in the loss (``tools.py:187-213``:
+  the Meters are re-created inside the epoch loop);
+- plain SGD, constant lr within the call (``tools.py:185``).
+
+Client-ordering semantics: ``parallel`` (default) starts every client
+from the same global parameters — what the paper describes and what a
+vmapped kernel naturally computes. ``sequential`` reproduces the
+reference's artifact where client i+1 starts from client i's final
+weights (the same model object is mutated across the loop,
+``tools.py:341``); it is a ``lax.scan`` carrying the parameters, for A/B
+parity runs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from .batching import epoch_batches, weighted_epoch_metrics
+
+
+def make_local_update(
+    apply_fn: Callable,
+    task: str,
+    epochs: int,
+    batch_size: int,
+    n_max: int,
+):
+    """Build the single-client local-SGD kernel.
+
+    Returns ``local_update(params, X, y, idx, mask, key, lr, mu, lam) ->
+    (new_params, last_epoch_loss, last_epoch_acc)`` where ``X, y`` are the
+    full shared arrays, ``idx/mask`` the client's padded row indices and
+    validity mask of shape ``(n_max,)``, and ``lr/mu/lam`` dynamic
+    scalars (no retrace across rounds).
+    """
+    def batch_objective(params, anchor, xb, yb, bv, mu, lam):
+        from ..ops.losses import training_loss
+
+        return training_loss(
+            params, anchor, apply_fn, xb, yb, bv, task, mu, lam
+        )
+
+    grad_fn = jax.value_and_grad(batch_objective, has_aux=True)
+
+    def local_update(params, X, y, idx, mask, key, lr, mu, lam):
+        from ..ops.metrics import top1_correct
+
+        anchor = params  # deep-copy of the incoming model (tools.py:180)
+
+        def epoch_body(p, key_e):
+            # Fresh shuffle: valid rows first in random order, padding last.
+            b_pos, b_valid = epoch_batches(key_e, n_max, batch_size, mask)
+
+            def step(p, inp):
+                pos, bv = inp
+                rows = idx[pos]
+                xb = X[rows]
+                yb = y[rows]
+                (loss, (preds, cnt)), grads = grad_fn(
+                    p, anchor, xb, yb, bv, mu, lam
+                )
+                ok = (cnt > 0).astype(jnp.float32)
+                p = jax.tree.map(lambda w, g: w - lr * ok * g, p, grads)
+                if task == "classification":
+                    correct = jnp.sum(top1_correct(preds, yb) * bv)
+                else:
+                    correct = jnp.float32(0.0)
+                return p, (loss * cnt, correct, cnt)
+
+            p, (losses, corrects, cnts) = jax.lax.scan(step, p, (b_pos, b_valid))
+            return p, weighted_epoch_metrics(losses, corrects, cnts)
+
+        keys = jax.random.split(key, epochs)
+        params, (ep_losses, ep_accs) = jax.lax.scan(epoch_body, params, keys)
+        return params, ep_losses[-1], ep_accs[-1]
+
+    return local_update
+
+
+def make_client_round(
+    apply_fn: Callable,
+    task: str,
+    epochs: int,
+    batch_size: int,
+    n_max: int,
+    sequential: bool = False,
+):
+    """Lift the kernel over the client axis.
+
+    Returns ``round_fn(params, X, y, idx (J,n_max), mask (J,n_max),
+    keys (J,...), lr, mu, lam) -> (stacked_params with leading J axis,
+    losses (J,), accs (J,))``.
+
+    ``parallel``: ``jax.vmap`` with the global params broadcast — every
+    client starts from the same state. ``sequential``: ``lax.scan``
+    carrying params client-to-client (reference contamination artifact).
+    """
+    local_update = make_local_update(apply_fn, task, epochs, batch_size, n_max)
+
+    if not sequential:
+        vmapped = jax.vmap(
+            local_update,
+            in_axes=(None, None, None, 0, 0, 0, None, None, None),
+        )
+
+        def round_fn(params, X, y, idx, mask, keys, lr, mu, lam):
+            return vmapped(params, X, y, idx, mask, keys, lr, mu, lam)
+
+    else:
+
+        def round_fn(params, X, y, idx, mask, keys, lr, mu, lam):
+            def body(p, inp):
+                idx_j, mask_j, key_j = inp
+                new_p, loss_j, acc_j = local_update(
+                    p, X, y, idx_j, mask_j, key_j, lr, mu, lam
+                )
+                return new_p, (new_p, loss_j, acc_j)
+
+            _, (stacked, losses, accs) = jax.lax.scan(
+                body, params, (idx, mask, keys)
+            )
+            return stacked, losses, accs
+
+    return round_fn

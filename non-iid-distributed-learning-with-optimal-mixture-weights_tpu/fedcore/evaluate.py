@@ -1,0 +1,36 @@
+"""Jitted evaluation (reference ``test_loop``, ``functions/tools.py:218-237``).
+
+The reference shuffles the test set into batches of 32 and Meter-averages
+per-batch means weighted by batch size — which is exactly the full-set
+mean, so the TPU version is one batched forward pass. (The shuffle,
+``tools.py:220``, only randomizes batch order and cannot change the
+weighted average.) Accuracy for regression tasks is reported as 0.0; the
+reference computes ``comp_accuracy`` on float targets there, which is
+meaningless (SURVEY.md §2.2 component 22).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def make_evaluator(apply_fn: Callable, task: str):
+    """Returns jitted ``evaluate(params, X, y) -> (loss, acc_percent)``."""
+    from ..ops.losses import ce_per_example, mse_per_example
+    from ..ops.metrics import top1_correct
+
+    @jax.jit
+    def evaluate(params, X, y):
+        preds = apply_fn(params, X)
+        if task == "classification":
+            loss = jnp.mean(ce_per_example(preds, y))
+            acc = 100.0 * jnp.mean(top1_correct(preds, y))
+        else:
+            loss = jnp.mean(mse_per_example(preds, y))
+            acc = jnp.float32(0.0)
+        return loss, acc
+
+    return evaluate

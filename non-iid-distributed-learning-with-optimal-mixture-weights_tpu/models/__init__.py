@@ -1,0 +1,3 @@
+from .linear import Model, get_model, linear_model, mlp_model, xavier_uniform
+
+__all__ = ["Model", "get_model", "linear_model", "mlp_model", "xavier_uniform"]

@@ -1,0 +1,82 @@
+"""Model zoo: pytree models with init/apply pairs.
+
+The reference's "MLP" (``functions/tools.py:34-40``) is a single
+bias-free ``nn.Linear`` — the whole model is one ``(C, D)`` matrix with
+Xavier-uniform init. That single-matrix structure is what makes stacking
+all client models into a dense ``(J, C, D)`` tensor (and the FedAMW
+mixture einsum over it) possible, so the linear model is the flagship
+here too. ``mlp`` is the genuinely multi-layer variant for the larger
+scale configs (e.g. covtype 2-layer MLP); every model is a plain pytree,
+and aggregation is pytree-generic, so any of them federate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Model:
+    """An init/apply pair over a parameter pytree."""
+
+    name: str
+    init: Callable[[jax.Array, int, int], dict]
+    apply: Callable[[dict, jax.Array], jax.Array]
+
+
+def xavier_uniform(key: jax.Array, shape: tuple[int, int]) -> jax.Array:
+    """torch ``xavier_uniform_`` for a (fan_out, fan_in) weight."""
+    fan_out, fan_in = shape
+    bound = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(
+        key, shape, dtype=jnp.float32, minval=-bound, maxval=bound
+    )
+
+
+def _linear_init(key, d, num_classes):
+    return {"w": xavier_uniform(key, (num_classes, d))}
+
+
+def _linear_apply(params, x):
+    return x @ params["w"].T
+
+
+def linear_model() -> Model:
+    """The reference's bias-free linear classifier (``tools.py:34-40``)."""
+    return Model(name="linear", init=_linear_init, apply=_linear_apply)
+
+
+def mlp_model(hidden: int = 64) -> Model:
+    """A true 2-layer MLP (hidden ReLU layer, biasless output).
+
+    Not in the reference (its 'MLP' is linear); needed for the scale
+    config "covtype 2-layer MLP, 1024 clients" (BASELINE.md).
+    """
+
+    def init(key, d, num_classes):
+        k1, k2 = jax.random.split(key)
+        return {
+            "w1": xavier_uniform(k1, (hidden, d)),
+            "b1": jnp.zeros((hidden,), jnp.float32),
+            "w2": xavier_uniform(k2, (num_classes, hidden)),
+        }
+
+    def apply(params, x):
+        h = jax.nn.relu(x @ params["w1"].T + params["b1"])
+        return h @ params["w2"].T
+
+    return Model(name=f"mlp{hidden}", init=init, apply=apply)
+
+
+def get_model(name: str, **kwargs) -> Model:
+    if name == "linear":
+        return linear_model()
+    if name.startswith("mlp"):
+        hidden = int(name[3:]) if len(name) > 3 else kwargs.pop("hidden", 64)
+        return mlp_model(hidden)
+    raise ValueError(f"unknown model: {name}")

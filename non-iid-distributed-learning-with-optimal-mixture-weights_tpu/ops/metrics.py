@@ -1,0 +1,86 @@
+"""Accuracy metrics and the streaming Meter accumulator.
+
+``comp_accuracy`` keeps the reference's surface (top-k percentages,
+``functions/tools.py:82-96``); the jit-friendly primitives below it are
+what the kernels use. ``Meter`` reproduces the reference accumulator
+(``tools.py:99-166``) for the torch backend and host-side logging.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def top1_correct(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Per-example 0/1 top-1 correctness (float)."""
+    return (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+
+
+def masked_accuracy(logits: jax.Array, labels: jax.Array, mask: jax.Array) -> jax.Array:
+    """Top-1 accuracy in percent over mask==1 entries."""
+    correct = top1_correct(logits, labels)
+    return 100.0 * jnp.sum(correct * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def comp_accuracy(output, target, topk=(1,)):
+    """Top-k accuracies in percent (reference ``tools.py:82-96`` surface).
+
+    Works on numpy or JAX arrays; returns a list of floats.
+    """
+    output = np.asarray(output)
+    target = np.asarray(target)
+    maxk = max(topk)
+    # top-maxk predictions, most likely first
+    pred = np.argsort(-output, axis=1)[:, :maxk]
+    correct = pred == target[:, None]
+    res = []
+    for k in topk:
+        res.append(100.0 * float(correct[:, :k].sum()) / target.shape[0])
+    return res
+
+
+class Meter:
+    """Streaming mean/std/MAD accumulator (reference ``tools.py:99-166``)."""
+
+    def __init__(self, init_dict=None, ptag="Time", stateful=False, csv_format=True):
+        self.reset()
+        self.ptag = ptag
+        self.stateful = stateful
+        self.value_history = [] if stateful else None
+        self.csv_format = csv_format
+        if init_dict:
+            for key, val in init_dict.items():
+                setattr(self, key, val)
+
+    def reset(self):
+        self.val = 0.0
+        self.avg = 0.0
+        self.sum = 0.0
+        self.count = 0
+        self.std = 0.0
+        self.sqsum = 0.0
+        self.mad = 0.0
+
+    def update(self, val, n=1):
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / self.count
+        self.sqsum += (val**2) * n
+        if self.count > 1:
+            self.std = (
+                (self.sqsum - (self.sum**2) / self.count) / (self.count - 1)
+            ) ** 0.5
+        if self.stateful:
+            self.value_history.append(val)
+            self.mad = sum(abs(v - self.avg) for v in self.value_history) / len(
+                self.value_history
+            )
+
+    def __str__(self):
+        spread = self.mad if self.stateful else self.std
+        if self.csv_format:
+            return f"{self.val:.3f},{self.avg:.3f},{spread:.3f}"
+        return f"{self.ptag}: {self.val:.3f} ({self.avg:.3f} +- {spread:.3f})"

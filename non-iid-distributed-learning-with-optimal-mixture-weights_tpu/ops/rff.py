@@ -1,0 +1,73 @@
+"""Random Fourier Features (Gaussian kernel approximation).
+
+Reference: ``functions/tools.py:15-31``. ``W ~ N(0, sigma)`` of shape
+``(d, D)``, ``b ~ U(0, 2*pi)``, and the map ``phi(X) = cos(X W + b) / sqrt(D)``
+(the reference's normalization — it approximates half the Gaussian
+kernel, which only rescales the linear model on top). Drawn from
+``jax.random`` instead of torch's global RNG; train and test are mapped
+with the same draw, computed once, jitted, on device.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def rff_params(key: jax.Array, d: int, D: int, sigma: float):
+    """Sample the random projection. ``sigma`` is the reference's
+    ``kernel_par`` (std of the normal draw, ``tools.py:17``)."""
+    k_w, k_b = jax.random.split(key)
+    W = sigma * jax.random.normal(k_w, (d, D), dtype=jnp.float32)
+    b = jax.random.uniform(
+        k_b, (1, D), dtype=jnp.float32, minval=0.0, maxval=2.0 * math.pi
+    )
+    return W, b
+
+
+@jax.jit
+def rff_map(X: jax.Array, W: jax.Array, b: jax.Array) -> jax.Array:
+    """``phi(X) = cos(X W + b) / sqrt(D)`` — one fused matmul+cos on the MXU."""
+    D = W.shape[1]
+    return jnp.cos(X @ W + b) / jnp.sqrt(jnp.float32(D))
+
+
+def feature_mapping(
+    X_train: jax.Array,
+    X_test: jax.Array,
+    key: jax.Array,
+    kernel_par: float = 10.0,
+    D: int = 200,
+    kernel_type: str = "gaussian",
+):
+    """Map train and test through the same RFF draw (``tools.py:22-31``).
+
+    Identity for non-Gaussian ``kernel_type``, as in the reference.
+    Returns ``(X_train_FM, X_test_FM, (W, b) | None)``.
+    """
+    if kernel_type != "gaussian":
+        return X_train, X_test, None
+    W, b = rff_params(key, X_train.shape[-1], D, kernel_par)
+    return rff_map(X_train, W, b), rff_map(X_test, W, b), (W, b)
+
+
+@partial(jax.jit, static_argnames=("block",))
+def data_heterogeneity(X: jax.Array, idx: jax.Array, mask: jax.Array, block: int = 0):
+    """Dataset-level non-IIDness score (reference ``exp.py:66-76``):
+    ``sum_j (n_j/n) * ||C - C_j||_F`` with ``C = X^T X / n`` the global
+    second moment and ``C_j`` the per-client one, computed from the
+    packed client index sets.
+    """
+    n = X.shape[0]
+    C = X.T @ X / n
+
+    def per_client(idx_j, mask_j):
+        Xj = X[idx_j] * mask_j[:, None]
+        nj = jnp.maximum(mask_j.sum(), 1.0)
+        Cj = Xj.T @ Xj / nj
+        return mask_j.sum() / n * jnp.linalg.norm(C - Cj)
+
+    return jax.lax.map(lambda args: per_client(*args), (idx, mask)).sum()

@@ -1,0 +1,17 @@
+from .mesh import (
+    CLIENT_AXIS,
+    client_spec,
+    make_mesh,
+    replicated,
+    shard_client_keys,
+    shard_setup,
+)
+
+__all__ = [
+    "CLIENT_AXIS",
+    "client_spec",
+    "make_mesh",
+    "replicated",
+    "shard_client_keys",
+    "shard_setup",
+]

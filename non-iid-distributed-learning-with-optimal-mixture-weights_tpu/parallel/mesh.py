@@ -1,0 +1,94 @@
+"""Client-axis data parallelism over a ``jax.sharding.Mesh``.
+
+This is the framework's "distributed communication backend". The
+reference imports ``torch.distributed`` but never calls it — all its
+"communication" is Python-list state_dict passing in one process
+(``functions/utils.py:9-14``; SURVEY.md §5). Here, scale-out is real and
+TPU-native: the client axis of the packed index sets (and of every
+stacked parameter pytree) is sharded across the mesh, the vmapped
+local-update kernel runs on each shard's clients in parallel, and the
+weighted-average aggregation ``sum_j p_j theta_j`` — a ``tensordot``
+over the client axis — lowers to an XLA ``psum``-style all-reduce over
+ICI under ``jit``. No explicit collective code: placement + jit is the
+whole backend, which is the point of the pjit programming model. The
+same program runs unchanged on 1 chip or a full pod slice.
+
+Shardings used (client-axis DP — the only parallelism axis this model
+family has; a (C, D) linear model is far too small to shard itself):
+
+- ``idx/mask/keys``:       P('clients', None)  — split over the mesh
+- ``X/y/X_val/X_test``:    P()                 — replicated (read-only)
+- ``params/p``:            P()                 — replicated
+- stacked client params:   P('clients', ...)   — jit-chosen, reduced away
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CLIENT_AXIS = "clients"
+
+
+def make_mesh(n_devices: int | None = None, axis_name: str = CLIENT_AXIS) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` local devices."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis_name,))
+
+
+def client_spec(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Leading-axis client sharding for an ndim-D array."""
+    return NamedSharding(
+        mesh, P(mesh.axis_names[0], *([None] * (ndim - 1)))
+    )
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_setup(setup, mesh: Mesh):
+    """Place a ``FedSetup`` on the mesh: client index sets sharded over
+    the client axis, shared matrices replicated.
+
+    The number of clients must divide the mesh size evenly for an even
+    shard; use ``pack_partitions(..., pad_clients_to=...)`` (empty
+    clients are inert and carry zero aggregation weight).
+    """
+    n_dev = mesh.devices.size
+    j = setup.idx.shape[0]
+    if j % n_dev != 0:
+        raise ValueError(
+            f"{j} clients not divisible by {n_dev} devices; "
+            f"pad with pack_partitions(pad_clients_to=...)"
+        )
+    cs2 = client_spec(mesh, 2)
+    cs1 = client_spec(mesh, 1)
+    rep = replicated(mesh)
+    return dataclasses.replace(
+        setup,
+        idx=jax.device_put(setup.idx, cs2),
+        mask=jax.device_put(setup.mask, cs2),
+        sizes=jax.device_put(setup.sizes, cs1),
+        p_fixed=jax.device_put(setup.p_fixed, rep),
+        X=jax.device_put(setup.X, rep),
+        y=jax.device_put(setup.y, rep),
+        X_test=jax.device_put(setup.X_test, rep),
+        y_test=jax.device_put(setup.y_test, rep),
+        X_val=jax.device_put(setup.X_val, rep),
+        y_val=jax.device_put(setup.y_val, rep),
+    )
+
+
+def shard_client_keys(keys: jax.Array, mesh: Mesh) -> jax.Array:
+    """Shard a (J, ...) per-client key array over the client axis."""
+    return jax.device_put(keys, client_spec(mesh, keys.ndim))

@@ -1,0 +1,120 @@
+"""End-to-end algorithm tests: small synthetic federations on CPU.
+
+Raw (identity-mapped) digits features converge in a handful of rounds,
+which keeps these fast; one test exercises the full RFF path. Short runs
+use ``lr_mode='constant'`` — the reference's compounding decay schedule
+zeroes the lr almost immediately at tiny round counts.
+"""
+
+import numpy as np
+import pytest
+
+from fedamw_tpu.algorithms import (
+    ALGORITHMS,
+    Centralized,
+    Distributed,
+    FedAMW,
+    FedAMW_OneShot,
+    FedAvg,
+    FedNova,
+    FedProx,
+    prepare_setup,
+)
+from fedamw_tpu.data import load_dataset
+
+
+@pytest.fixture(scope="module")
+def setup():
+    ds = load_dataset("digits", num_partitions=4, alpha=0.5)
+    return prepare_setup(ds, kernel_type="linear", seed=100,
+                         rng=np.random.RandomState(100))
+
+
+class TestRoundBased:
+    def test_fedavg_learns(self, setup):
+        res = FedAvg(setup, lr=0.5, epoch=2, batch_size=32, round=8, seed=0,
+                     lr_mode="constant")
+        assert res["test_acc"].shape == (8,)
+        assert res["train_loss"].shape == (8,)
+        assert res["test_acc"][-1] > 85.0
+        assert res["test_loss"][-1] < res["test_loss"][0]
+
+    def test_fedavg_reference_schedule_decays(self, setup):
+        res = FedAvg(setup, lr=0.5, epoch=1, round=8, seed=0,
+                     lr_mode="reference")
+        # decay at t=4 (/10) and t=6 (/1000): late rounds barely move
+        late_delta = abs(res["test_acc"][-1] - res["test_acc"][-2])
+        assert late_delta < 1.0
+
+    def test_fedprox_runs(self, setup):
+        res = FedProx(setup, lr=0.5, epoch=2, round=6, prox=True, mu=0.01,
+                      seed=0, lr_mode="constant")
+        assert res["test_acc"][-1] > 80.0
+
+    def test_fednova_runs(self, setup):
+        res = FedNova(setup, lr=0.5, epoch=2, round=6, seed=0,
+                      lr_mode="constant")
+        assert res["test_acc"][-1] > 80.0
+
+    def test_fedamw_learns_p(self, setup):
+        res = FedAMW(setup, lr=0.5, epoch=2, round=6, lambda_reg_if=True,
+                     lambda_reg=5e-5, lr_p=0.01, seed=0, lr_mode="constant")
+        assert res["test_acc"].shape == (6,)
+        assert res["test_acc"][-1] > 80.0
+
+    def test_seed_determinism(self, setup):
+        a = FedAvg(setup, lr=0.5, epoch=1, round=3, seed=4, lr_mode="constant")
+        b = FedAvg(setup, lr=0.5, epoch=1, round=3, seed=4, lr_mode="constant")
+        np.testing.assert_allclose(a["test_acc"], b["test_acc"])
+
+    def test_sequential_mode_differs(self, setup):
+        par = FedAvg(setup, lr=0.5, epoch=1, round=2, seed=0, lr_mode="constant")
+        seq = FedAvg(setup, lr=0.5, epoch=1, round=2, seed=0,
+                     lr_mode="constant", sequential=True)
+        assert not np.allclose(par["test_acc"], seq["test_acc"])
+
+
+class TestOneShot:
+    def test_centralized_upper_bound(self, setup):
+        res = Centralized(setup, lr=0.5, epoch=8, batch_size=32, seed=0)
+        assert res["test_acc"].ndim == 0
+        assert float(res["test_acc"]) > 90.0
+
+    def test_distributed(self, setup):
+        res = Distributed(setup, lr=0.5, epoch=8, batch_size=32, seed=0)
+        assert float(res["test_acc"]) > 70.0
+
+    def test_fedamw_oneshot(self, setup):
+        res = FedAMW_OneShot(setup, lr=0.5, epoch=8, round=5,
+                             lambda_reg_if=True, lambda_reg=5e-4,
+                             lr_p=0.05, seed=0)
+        assert res["test_acc"].shape == (5,)
+        assert res["test_acc"][-1] > 70.0
+        # no p[0]^t aliasing: accuracy must not collapse over iterations
+        assert res["test_acc"][-1] >= res["test_acc"][0] - 10.0
+
+
+def test_rff_path_end_to_end():
+    ds = load_dataset("digits", num_partitions=4, alpha=0.5)
+    setup = prepare_setup(ds, D=256, kernel_par=1.0, seed=100,
+                          rng=np.random.RandomState(100))
+    res = FedAvg(setup, lr=2.0, epoch=2, round=12, seed=0, lr_mode="constant")
+    assert res["test_acc"][-1] > 40.0
+    assert res["test_acc"][-1] > res["test_acc"][0]
+
+
+def test_registry_complete():
+    assert set(ALGORITHMS) == {
+        "Centralized", "Distributed", "FedAMW_OneShot",
+        "FedAvg", "FedProx", "FedNova", "FedAMW",
+    }
+
+
+def test_regression_task():
+    ds = load_dataset("synthetic_nonlinear", num_partitions=4, alpha=1.0)
+    setup = prepare_setup(ds, D=64, kernel_par=0.1, seed=1,
+                          rng=np.random.RandomState(1))
+    res = FedAvg(setup, lr=0.05, epoch=1, round=3, seed=0, lr_mode="constant")
+    assert res["test_loss"].shape == (3,)
+    assert np.all(np.isfinite(res["test_loss"]))
+    assert res["test_loss"][-1] < res["test_loss"][0]

@@ -1,0 +1,275 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedamw_tpu.fedcore import (
+    client_logits,
+    fednova_effective_weights,
+    make_client_round,
+    make_evaluator,
+    make_local_update,
+    make_p_solver,
+    weighted_average,
+)
+from fedamw_tpu.models import linear_model
+
+
+def _torch_full_batch_sgd(w0, X, y, lr, epochs, mu, lam, task):
+    """Trusted torch re-statement of the reference train_loop objective
+    (tools.py:193-211) with batch_size >= n, so no shuffle dependence."""
+    import torch
+
+    w = torch.tensor(np.array(w0), requires_grad=True)
+    anchor = torch.tensor(np.array(w0))
+    Xt = torch.tensor(np.array(X))
+    if task == "classification":
+        yt = torch.tensor(np.array(y), dtype=torch.long)
+        crit = torch.nn.CrossEntropyLoss()
+    else:
+        yt = torch.tensor(np.array(y)).reshape(-1, 1)
+        crit = torch.nn.MSELoss()
+    last_loss = None
+    for _ in range(epochs):
+        out = Xt @ w.T
+        loss = crit(out, yt) + mu * (w - anchor).norm(2) + lam * torch.norm(w, "fro")
+        (g,) = torch.autograd.grad(loss, w)
+        last_loss = float(loss)
+        w = (w - lr * g).detach().requires_grad_()
+    return w.detach().numpy(), last_loss
+
+
+@pytest.fixture
+def small_problem():
+    rng = np.random.RandomState(0)
+    n, d, C = 24, 6, 3
+    X = rng.randn(n, d).astype(np.float32)
+    y = rng.randint(0, C, n).astype(np.int32)
+    model = linear_model()
+    w0 = model.init(jax.random.PRNGKey(0), d, C)
+    return X, y, model, w0
+
+
+class TestLocalUpdateParity:
+    @pytest.mark.parametrize(
+        "mu,lam", [(0.0, 0.0), (0.3, 0.0), (0.0, 0.2), (0.3, 0.2)]
+    )
+    def test_full_batch_matches_torch(self, small_problem, mu, lam):
+        X, y, model, w0 = small_problem
+        n = len(y)
+        lu = make_local_update(model.apply, "classification", 3, n, n)
+        idx = jnp.arange(n, dtype=jnp.int32)
+        mask = jnp.ones(n)
+        new_p, loss, _ = lu(
+            w0, jnp.array(X), jnp.array(y), idx, mask,
+            jax.random.PRNGKey(5), 0.1, mu, lam,
+        )
+        want_w, want_loss = _torch_full_batch_sgd(
+            w0["w"], X, y, 0.1, 3, mu, lam, "classification"
+        )
+        np.testing.assert_allclose(np.array(new_p["w"]), want_w, atol=1e-5)
+        # returned loss is the last epoch's (pre-step) objective
+        assert float(loss) == pytest.approx(want_loss, abs=1e-5)
+
+    def test_regression_full_batch(self, small_problem):
+        X, _, model, _ = small_problem
+        n = X.shape[0]
+        yreg = (X @ np.ones(X.shape[1])).astype(np.float32)
+        w0 = {"w": jnp.zeros((1, X.shape[1]))}
+        lu = make_local_update(model.apply, "regression", 2, n, n)
+        new_p, loss, acc = lu(
+            w0, jnp.array(X), jnp.array(yreg),
+            jnp.arange(n, dtype=jnp.int32), jnp.ones(n),
+            jax.random.PRNGKey(0), 0.01, 0.0, 0.0,
+        )
+        want_w, want_loss = _torch_full_batch_sgd(
+            np.zeros((1, X.shape[1]), np.float32), X, yreg, 0.01, 2, 0.0, 0.0,
+            "regression",
+        )
+        np.testing.assert_allclose(np.array(new_p["w"]), want_w, atol=1e-5)
+        assert float(loss) == pytest.approx(want_loss, abs=1e-5)
+        assert float(acc) == 0.0
+
+    def test_padding_is_inert(self, small_problem):
+        X, y, model, w0 = small_problem
+        n = len(y)
+        n_max = n + 8
+        lu = make_local_update(model.apply, "classification", 2, 8, n_max)
+        mask = jnp.concatenate([jnp.ones(n), jnp.zeros(8)])
+        # identical real rows, two different garbage paddings
+        idx_a = jnp.concatenate([jnp.arange(n), jnp.zeros(8, jnp.int32)]).astype(jnp.int32)
+        idx_b = jnp.concatenate([jnp.arange(n), jnp.full(8, n - 1, jnp.int32)]).astype(jnp.int32)
+        out_a = lu(w0, jnp.array(X), jnp.array(y), idx_a, mask,
+                   jax.random.PRNGKey(3), 0.1, 0.1, 0.1)
+        out_b = lu(w0, jnp.array(X), jnp.array(y), idx_b, mask,
+                   jax.random.PRNGKey(3), 0.1, 0.1, 0.1)
+        np.testing.assert_allclose(
+            np.array(out_a[0]["w"]), np.array(out_b[0]["w"]), atol=1e-6
+        )
+        assert float(out_a[1]) == pytest.approx(float(out_b[1]), abs=1e-6)
+
+    def test_empty_client_is_identity(self, small_problem):
+        X, y, model, w0 = small_problem
+        lu = make_local_update(model.apply, "classification", 2, 8, 16)
+        new_p, loss, acc = lu(
+            w0, jnp.array(X), jnp.array(y),
+            jnp.zeros(16, jnp.int32), jnp.zeros(16),
+            jax.random.PRNGKey(0), 0.1, 0.0, 0.0,
+        )
+        np.testing.assert_allclose(np.array(new_p["w"]), np.array(w0["w"]))
+        assert float(loss) == 0.0
+
+
+class TestClientRound:
+    def _pack(self, X, y, parts, n_max):
+        J = len(parts)
+        idx = np.zeros((J, n_max), np.int32)
+        mask = np.zeros((J, n_max), np.float32)
+        for j, p in enumerate(parts):
+            idx[j, : len(p)] = p
+            mask[j, : len(p)] = 1.0
+        return jnp.array(idx), jnp.array(mask)
+
+    def test_parallel_equals_individual(self, small_problem):
+        X, y, model, w0 = small_problem
+        parts = [np.arange(0, 10), np.arange(10, 24)]
+        idx, mask = self._pack(X, y, parts, 14)
+        keys = jax.random.split(jax.random.PRNGKey(9), 2)
+        rf = make_client_round(model.apply, "classification", 2, 4, 14)
+        stacked, losses, accs = rf(
+            w0, jnp.array(X), jnp.array(y), idx, mask, keys, 0.1, 0.0, 0.0
+        )
+        lu = make_local_update(model.apply, "classification", 2, 4, 14)
+        for j in range(2):
+            pj, lj, aj = lu(
+                w0, jnp.array(X), jnp.array(y), idx[j], mask[j], keys[j],
+                0.1, 0.0, 0.0,
+            )
+            np.testing.assert_allclose(
+                np.array(stacked["w"][j]), np.array(pj["w"]), atol=1e-6
+            )
+            assert float(losses[j]) == pytest.approx(float(lj), abs=1e-6)
+
+    def test_sequential_contamination(self, small_problem):
+        X, y, model, w0 = small_problem
+        parts = [np.arange(0, 12), np.arange(12, 24)]
+        idx, mask = self._pack(X, y, parts, 12)
+        keys = jax.random.split(jax.random.PRNGKey(9), 2)
+        rf_seq = make_client_round(
+            model.apply, "classification", 2, 12, 12, sequential=True
+        )
+        stacked, _, _ = rf_seq(
+            w0, jnp.array(X), jnp.array(y), idx, mask, keys, 0.1, 0.0, 0.0
+        )
+        # client 0 starts from the global params...
+        lu = make_local_update(model.apply, "classification", 2, 12, 12)
+        p0, _, _ = lu(w0, jnp.array(X), jnp.array(y), idx[0], mask[0], keys[0],
+                      0.1, 0.0, 0.0)
+        np.testing.assert_allclose(np.array(stacked["w"][0]), np.array(p0["w"]),
+                                   atol=1e-6)
+        # ...and client 1 starts from client 0's result (the reference quirk)
+        p1, _, _ = lu(p0, jnp.array(X), jnp.array(y), idx[1], mask[1], keys[1],
+                      0.1, 0.0, 0.0)
+        np.testing.assert_allclose(np.array(stacked["w"][1]), np.array(p1["w"]),
+                                   atol=1e-6)
+
+
+class TestAggregate:
+    def test_weighted_average_closed_form(self):
+        stacked = {"w": jnp.stack([jnp.full((2, 2), 1.0), jnp.full((2, 2), 3.0)])}
+        p = jnp.array([0.25, 0.75])
+        out = weighted_average(stacked, p)
+        np.testing.assert_allclose(np.array(out["w"]), np.full((2, 2), 2.5))
+
+    def test_fednova_weights(self):
+        sizes = jnp.array([100, 300])
+        p = jnp.array([0.25, 0.75])
+        w = fednova_effective_weights(sizes, p, epochs=2, batch_size=32)
+        tau = np.array([100 * 2 / 32, 300 * 2 / 32])
+        tau_eff = (tau * np.array([0.25, 0.75])).sum()
+        np.testing.assert_allclose(
+            np.array(w), np.array([0.25, 0.75]) * tau_eff / tau, rtol=1e-6
+        )
+
+    def test_fednova_weights_padded_clients_inert(self):
+        # padded clients (size 0, p 0) must not produce NaNs (0/0)
+        sizes = jnp.array([100, 80, 0, 0])
+        p = jnp.array([100 / 180, 80 / 180, 0.0, 0.0])
+        w = fednova_effective_weights(sizes, p, epochs=2, batch_size=32)
+        assert np.all(np.isfinite(np.array(w)))
+        np.testing.assert_allclose(np.array(w[2:]), 0.0)
+
+    def test_client_logits_matches_reference_einsum(self):
+        model = linear_model()
+        J, C, D, n = 3, 4, 5, 7
+        rng = np.random.RandomState(0)
+        W = rng.randn(J, C, D).astype(np.float32)
+        X = rng.randn(n, D).astype(np.float32)
+        out = client_logits(model.apply, {"w": jnp.array(W)}, jnp.array(X))
+        want = np.einsum("jcd,nd->njc", W, X)
+        np.testing.assert_allclose(np.array(out), want, atol=1e-5)
+
+
+class TestPSolver:
+    def test_momentum_matches_torch(self):
+        """One full-coverage batch per epoch -> deterministic; check the
+        SGD-momentum recurrence against torch (tools.py:423)."""
+        import torch
+
+        rng = np.random.RandomState(0)
+        n_val, J, C = 8, 2, 3
+        logits = rng.randn(n_val, J, C).astype(np.float32)
+        y = rng.randint(0, C, n_val).astype(np.int32)
+        p0 = np.array([0.5, 0.5], np.float32)
+
+        solve, init_opt = make_p_solver(
+            "classification", n_val, batch_size=n_val, lr_p=0.1, momentum=0.9
+        )
+        p, opt, loss, acc = solve(
+            jnp.array(logits), jnp.array(y), jnp.array(p0), init_opt(jnp.array(p0)),
+            jax.random.PRNGKey(0), 3,
+        )
+
+        pt = torch.tensor(p0, requires_grad=True)
+        opt_t = torch.optim.SGD([pt], lr=0.1, momentum=0.9)
+        lt = torch.tensor(logits)
+        yt = torch.tensor(y, dtype=torch.long)
+        for _ in range(3):
+            opt_t.zero_grad()
+            out = torch.einsum("bjc,j->bc", lt, pt)
+            torch.nn.CrossEntropyLoss()(out, yt).backward()
+            opt_t.step()
+        np.testing.assert_allclose(np.array(p), pt.detach().numpy(), atol=1e-5)
+
+    def test_p_moves_toward_good_client(self):
+        rng = np.random.RandomState(1)
+        n_val, C = 64, 4
+        y = rng.randint(0, C, n_val).astype(np.int32)
+        good = np.eye(C, dtype=np.float32)[y] * 10.0
+        bad = rng.randn(n_val, C).astype(np.float32)
+        logits = np.stack([good, bad], axis=1)  # (n, J=2, C)
+        p0 = jnp.array([0.5, 0.5])
+        solve, init_opt = make_p_solver(
+            "classification", n_val, batch_size=16, lr_p=0.05, momentum=0.9
+        )
+        p, _, loss, acc = solve(
+            jnp.array(logits), jnp.array(y), p0, init_opt(p0),
+            jax.random.PRNGKey(0), 20,
+        )
+        assert float(p[0]) > float(p[1])
+        assert float(acc) > 90.0
+
+
+def test_evaluator_matches_torch(small_problem):
+    import torch
+
+    X, y, model, w0 = small_problem
+    ev = make_evaluator(model.apply, "classification")
+    loss, acc = ev(w0, jnp.array(X), jnp.array(y))
+    out = torch.tensor(np.array(X)) @ torch.tensor(np.array(w0["w"])).T
+    want = torch.nn.CrossEntropyLoss()(out, torch.tensor(np.array(y), dtype=torch.long))
+    assert float(loss) == pytest.approx(float(want), abs=1e-5)
+    want_acc = 100.0 * float(
+        (out.argmax(1) == torch.tensor(np.array(y))).float().mean()
+    )
+    assert float(acc) == pytest.approx(want_acc, abs=1e-4)

@@ -1,0 +1,193 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedamw_tpu.models import get_model, linear_model, mlp_model
+from fedamw_tpu.ops import (
+    Meter,
+    ce_per_example,
+    comp_accuracy,
+    l2_norm_safe,
+    lr_schedule_array,
+    masked_accuracy,
+    masked_mean,
+    mse_per_example,
+    prox_penalty,
+    rff_map,
+    rff_params,
+    ridge_penalty,
+    training_loss,
+    update_learning_rate,
+)
+
+
+class TestRFF:
+    def test_shapes_and_range(self):
+        key = jax.random.PRNGKey(0)
+        W, b = rff_params(key, 5, 64, sigma=0.5)
+        assert W.shape == (5, 64) and b.shape == (1, 64)
+        X = jax.random.normal(jax.random.PRNGKey(1), (7, 5))
+        phi = rff_map(X, W, b)
+        assert phi.shape == (7, 64)
+        assert jnp.all(jnp.abs(phi) <= 1.0 / np.sqrt(64) + 1e-6)
+
+    def test_kernel_approximation(self):
+        # E[phi(x) . phi(y)] = 0.5 * exp(-sigma^2 ||x-y||^2 / 2) with the
+        # reference's 1/sqrt(D) normalization (tools.py:27).
+        sigma, D = 0.7, 60000
+        W, b = rff_params(jax.random.PRNGKey(2), 3, D, sigma)
+        x = jnp.array([[0.3, -0.1, 0.5]])
+        y = jnp.array([[-0.2, 0.4, 0.1]])
+        approx = float((rff_map(x, W, b) @ rff_map(y, W, b).T).squeeze())
+        exact = 0.5 * np.exp(-(sigma**2) * float(jnp.sum((x - y) ** 2)) / 2)
+        assert abs(approx - exact) < 0.01
+
+    def test_sigma_is_std(self):
+        W, _ = rff_params(jax.random.PRNGKey(3), 100, 2000, sigma=0.3)
+        assert abs(float(W.std()) - 0.3) < 0.005
+
+
+class TestLosses:
+    def test_ce_matches_torch(self):
+        import torch
+
+        logits = np.random.RandomState(0).randn(8, 5).astype(np.float32)
+        labels = np.random.RandomState(1).randint(0, 5, 8)
+        want = torch.nn.CrossEntropyLoss()(
+            torch.tensor(logits), torch.tensor(labels)
+        ).item()
+        got = float(masked_mean(ce_per_example(jnp.array(logits), jnp.array(labels)),
+                                jnp.ones(8)))
+        assert abs(got - want) < 1e-5
+
+    def test_mse_matches_torch(self):
+        import torch
+
+        preds = np.random.RandomState(0).randn(6, 1).astype(np.float32)
+        targets = np.random.RandomState(1).randn(6).astype(np.float32)
+        want = torch.nn.MSELoss()(
+            torch.tensor(preds), torch.tensor(targets).reshape(6, 1)
+        ).item()
+        got = float(masked_mean(mse_per_example(jnp.array(preds), jnp.array(targets)),
+                                jnp.ones(6)))
+        assert abs(got - want) < 1e-5
+
+    def test_masked_mean_ignores_padding(self):
+        v = jnp.array([1.0, 2.0, 100.0])
+        m = jnp.array([1.0, 1.0, 0.0])
+        assert float(masked_mean(v, m)) == pytest.approx(1.5)
+        assert float(masked_mean(v, jnp.zeros(3))) == 0.0
+
+    def test_prox_matches_torch_norm(self):
+        import torch
+
+        w = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        a = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        want = torch.norm(torch.tensor(w) - torch.tensor(a), 2).item()
+        got = float(prox_penalty({"w": jnp.array(w)}, {"w": jnp.array(a)}))
+        assert abs(got - want) < 1e-5
+
+    def test_prox_grad_zero_at_anchor(self):
+        w = {"w": jnp.ones((3, 4))}
+        g = jax.grad(lambda p: prox_penalty(p, w))(w)
+        assert jnp.all(jnp.isfinite(g["w"]))
+        assert float(jnp.abs(g["w"]).max()) == 0.0
+
+    def test_ridge_skips_biases(self):
+        params = {"w1": jnp.full((2, 2), 3.0), "b1": jnp.full((7,), 100.0)}
+        assert float(ridge_penalty(params)) == pytest.approx(6.0)
+
+    def test_training_loss_combination(self):
+        model = linear_model()
+        params = model.init(jax.random.PRNGKey(0), 4, 3)
+        anchor = jax.tree.map(lambda w: w + 1.0, params)
+        x = jnp.ones((2, 4))
+        y = jnp.array([0, 2])
+        m = jnp.ones(2)
+        base, _ = training_loss(params, anchor, model.apply, x, y, m,
+                                "classification", 0.0, 0.0)
+        with_pen, _ = training_loss(params, anchor, model.apply, x, y, m,
+                                    "classification", 0.5, 0.25)
+        expected = float(base) + 0.5 * float(prox_penalty(params, anchor)) \
+            + 0.25 * float(ridge_penalty(params))
+        assert float(with_pen) == pytest.approx(expected, rel=1e-5)
+
+    def test_l2_norm_safe_zero(self):
+        assert float(l2_norm_safe(jnp.zeros(5))) == 0.0
+        g = jax.grad(lambda x: l2_norm_safe(x))(jnp.zeros(5))
+        assert jnp.all(g == 0.0)
+
+
+class TestMetrics:
+    def test_masked_accuracy(self):
+        logits = jnp.array([[2.0, 1.0], [0.0, 3.0], [5.0, 0.0]])
+        labels = jnp.array([0, 1, 1])
+        acc = float(masked_accuracy(logits, labels, jnp.ones(3)))
+        assert acc == pytest.approx(100.0 * 2 / 3)
+        acc2 = float(masked_accuracy(logits, labels, jnp.array([1.0, 1.0, 0.0])))
+        assert acc2 == pytest.approx(100.0)
+
+    def test_comp_accuracy_matches_reference_semantics(self):
+        rng = np.random.RandomState(0)
+        out = rng.randn(20, 6)
+        target = rng.randint(0, 6, 20)
+        top1, top3 = comp_accuracy(out, target, topk=(1, 3))
+        want1 = 100.0 * np.mean(np.argmax(out, 1) == target)
+        assert top1 == pytest.approx(want1)
+        assert top3 >= top1
+
+    def test_meter(self):
+        m = Meter(ptag="Loss")
+        m.update(1.0, n=2)
+        m.update(4.0, n=1)
+        assert m.avg == pytest.approx(2.0)
+        assert m.count == 3
+
+
+class TestSchedule:
+    def test_reference_compounding(self):
+        lrs = lr_schedule_array(1.0, 100, "reference")
+        assert lrs[0] == 1.0 and lrs[49] == 1.0
+        assert lrs[50] == pytest.approx(0.1)
+        assert lrs[74] == pytest.approx(0.1)
+        assert lrs[75] == pytest.approx(0.001)  # compounded, not 0.01
+        assert lrs[99] == pytest.approx(0.001)
+
+    def test_paper_mode(self):
+        lrs = lr_schedule_array(1.0, 100, "paper")
+        assert lrs[75] == pytest.approx(0.01)
+
+    def test_matches_reference_recurrence(self):
+        # simulate the reference's reassignment loop via the
+        # reference-surface single-step function
+        for T in (1, 2, 3, 4, 7, 100):
+            lr = 0.5
+            expect = []
+            for t in range(T):
+                lr = update_learning_rate(t, lr, T)
+                expect.append(lr)
+            np.testing.assert_allclose(
+                lr_schedule_array(0.5, T, "reference"), expect, rtol=1e-6
+            )
+
+
+class TestModels:
+    def test_linear_forward(self):
+        model = linear_model()
+        params = model.init(jax.random.PRNGKey(0), 10, 3)
+        assert params["w"].shape == (3, 10)
+        bound = np.sqrt(6.0 / 13)
+        assert float(jnp.abs(params["w"]).max()) <= bound
+        out = model.apply(params, jnp.ones((5, 10)))
+        assert out.shape == (5, 3)
+
+    def test_mlp_forward(self):
+        model = mlp_model(hidden=16)
+        params = model.init(jax.random.PRNGKey(0), 10, 3)
+        out = model.apply(params, jnp.ones((5, 10)))
+        assert out.shape == (5, 3)
+
+    def test_get_model(self):
+        assert get_model("linear").name == "linear"
+        assert get_model("mlp32").name == "mlp32"
