@@ -30,6 +30,6 @@ Import via the repo-root alias module ``fedamw_tpu`` (this directory name
 is not a valid Python identifier).
 """
 
-from . import config  # noqa: F401
+from . import config, registry  # noqa: F401
 
 __version__ = "0.1.0"

@@ -1,0 +1,348 @@
+"""PyTorch-CPU execution backend.
+
+A from-scratch torch implementation of the same seven algorithms, kept
+behind the backend registry so the drivers run either path unchanged
+(the BASELINE.json north star: "gated behind the tools.py function
+registry"). It serves two purposes:
+
+1. the PyTorch-CPU baseline that ``bench.py`` measures the TPU path
+   against (the reference repo itself is not importable here and is
+   never copied);
+2. an independent same-semantics implementation for statistical
+   accuracy-parity tests between frameworks.
+
+Unlike the reference it shares one local-SGD routine and one round
+scaffold across algorithms, uses raw weight tensors + autograd instead
+of nn.Module machinery, and defaults to parallel client semantics
+(``sequential=True`` restores the reference's client-contamination
+artifact, as in the JAX path). Reference behaviors reproduced: the loss
+surface (``functions/tools.py:193-209``), last-epoch Meter averaging
+(:187-213), unconstrained mixture weights with SGD momentum 0.9 (:423),
+the compounding LR decay (:43-61), sample-count aggregation weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+from ..data import FederatedDataset, split_train_val
+from ..ops.schedule import lr_schedule_array
+
+
+@dataclasses.dataclass
+class TorchSetup:
+    task: str
+    num_classes: int
+    D: int
+    X: torch.Tensor              # (N, D) mapped features
+    y: torch.Tensor
+    X_test: torch.Tensor
+    y_test: torch.Tensor
+    X_val: torch.Tensor
+    y_val: torch.Tensor
+    parts: list                  # per-client index tensors
+    sizes: np.ndarray
+
+    @property
+    def num_clients(self) -> int:
+        return len(self.parts)
+
+    @property
+    def p_fixed(self) -> torch.Tensor:
+        s = torch.tensor(self.sizes, dtype=torch.float64)
+        return (s / s.sum()).float()
+
+
+def prepare_setup(
+    ds: FederatedDataset,
+    D: int = 2000,
+    kernel_par: float = 0.1,
+    kernel_type: str = "gaussian",
+    val_fraction: float = 0.2,
+    seed: int = 100,
+    rng: np.random.RandomState | None = None,
+    **_,
+) -> TorchSetup:
+    """Torch analog of ``algorithms.prepare_setup`` (RFF once, 80/20 val
+    pool, same index-set client layout)."""
+    if rng is None:
+        rng = np.random.RandomState(seed)
+    g = torch.Generator().manual_seed(seed)
+    X = torch.tensor(ds.X_train)
+    X_test = torch.tensor(ds.X_test)
+    if kernel_type == "gaussian":
+        W = torch.normal(0.0, kernel_par, size=(ds.d, D), generator=g)
+        b = 2 * math.pi * torch.rand(1, D, generator=g)
+        X = torch.cos(X @ W + b) / math.sqrt(D)
+        X_test = torch.cos(X_test @ W + b) / math.sqrt(D)
+        feat_dim = D
+    else:
+        feat_dim = ds.d
+
+    train_parts, val_idx = split_train_val(ds.parts, val_fraction, rng)
+    y = torch.tensor(
+        ds.y_train,
+        dtype=torch.long if ds.task_type == "classification" else torch.float32,
+    )
+    vi = torch.tensor(np.asarray(val_idx), dtype=torch.long)
+    return TorchSetup(
+        task=ds.task_type,
+        num_classes=ds.num_classes,
+        D=feat_dim,
+        X=X,
+        y=y,
+        X_test=X_test,
+        y_test=torch.tensor(
+            ds.y_test,
+            dtype=torch.long if ds.task_type == "classification" else torch.float32,
+        ),
+        X_val=X[vi],
+        y_val=y[vi],
+        parts=[torch.tensor(np.asarray(p), dtype=torch.long) for p in train_parts],
+        sizes=np.array([len(p) for p in train_parts]),
+    )
+
+
+def _init_weights(setup: TorchSetup, seed: int) -> torch.Tensor:
+    g = torch.Generator().manual_seed(seed * 7919 + 13)
+    bound = math.sqrt(6.0 / (setup.D + setup.num_classes))
+    return (torch.rand(setup.num_classes, setup.D, generator=g) * 2 - 1) * bound
+
+
+def _objective(w, anchor, xb, yb, task, mu, lam):
+    out = xb @ w.T
+    if task == "classification":
+        loss = F.cross_entropy(out, yb)
+    else:
+        loss = F.mse_loss(out, yb.reshape(-1, 1))
+    if mu:
+        loss = loss + mu * (w - anchor).norm(2)
+    if lam:
+        loss = loss + lam * w.norm("fro")
+    return loss, out
+
+
+def _local_sgd(w0, setup, part, lr, epochs, batch_size, mu, lam, generator):
+    """One client's local training; returns (weights, last-epoch loss/acc)."""
+    X, y, task = setup.X, setup.y, setup.task
+    w = w0.clone().requires_grad_(True)
+    anchor = w0.clone()
+    n = len(part)
+    ep_loss = ep_acc = 0.0
+    for _ in range(epochs):
+        order = part[torch.randperm(n, generator=generator)]
+        loss_sum = correct = count = 0.0
+        for start in range(0, n, batch_size):
+            rows = order[start : start + batch_size]
+            xb, yb = X[rows], y[rows]
+            loss, out = _objective(w, anchor, xb, yb, task, mu, lam)
+            (grad,) = torch.autograd.grad(loss, w)
+            with torch.no_grad():
+                w -= lr * grad
+            bs = len(rows)
+            loss_sum += float(loss.detach()) * bs
+            if task == "classification":
+                correct += float((out.argmax(1) == yb).sum())
+            count += bs
+        ep_loss = loss_sum / count
+        ep_acc = 100.0 * correct / count
+    return w.detach(), ep_loss, ep_acc
+
+
+def _evaluate(w, setup: TorchSetup):
+    with torch.no_grad():
+        out = setup.X_test @ w.T
+        if setup.task == "classification":
+            loss = float(F.cross_entropy(out, setup.y_test))
+            acc = 100.0 * float((out.argmax(1) == setup.y_test).float().mean())
+        else:
+            loss = float(F.mse_loss(out, setup.y_test.reshape(-1, 1)))
+            acc = 0.0
+    return loss, acc
+
+
+def _client_pass(setup, w_global, lr, epochs, batch_size, mu, lam, generator,
+                 sequential=False):
+    """All clients' local updates for one round."""
+    stacked, losses, accs = [], [], []
+    w_in = w_global
+    for part in setup.parts:
+        w_j, l_j, a_j = _local_sgd(
+            w_in, setup, part, lr, epochs, batch_size, mu, lam, generator
+        )
+        stacked.append(w_j)
+        losses.append(l_j)
+        accs.append(a_j)
+        if sequential:
+            w_in = w_j  # reference contamination artifact (tools.py:341)
+    return torch.stack(stacked), torch.tensor(losses), torch.tensor(accs)
+
+
+def _weighted_average(stacked: torch.Tensor, p: torch.Tensor) -> torch.Tensor:
+    return torch.einsum("j...,j->...", stacked, p)
+
+
+def _solve_p(logits, y_val, p, buf, lr_p, momentum, batch_size, epochs, task,
+             generator):
+    """Mixture-weight SGD over cached per-client val logits (same design
+    as the JAX solver). Returns (p, momentum_buffer)."""
+    n = len(y_val)
+    p = p.clone().requires_grad_(True)
+    for _ in range(epochs):
+        order = torch.randperm(n, generator=generator)
+        for start in range(0, n, batch_size):
+            rows = order[start : start + batch_size]
+            out = torch.einsum("bjc,j->bc", logits[rows], p)
+            if task == "classification":
+                loss = F.cross_entropy(out, y_val[rows])
+            else:
+                loss = F.mse_loss(out, y_val[rows].reshape(-1, 1))
+            (grad,) = torch.autograd.grad(loss, p)
+            with torch.no_grad():
+                if momentum:
+                    buf = momentum * buf + grad
+                    p -= lr_p * buf
+                else:
+                    p -= lr_p * grad
+    return p.detach(), buf
+
+
+def Centralized(setup, lr=0.01, epoch=200, batch_size=32, seed=0, **_):
+    g = torch.Generator().manual_seed(seed)
+    all_idx = torch.cat(setup.parts)
+    w, train_loss, _ = _local_sgd(
+        _init_weights(setup, seed), setup, all_idx, lr, epoch, batch_size,
+        0.0, 0.0, g,
+    )
+    test_loss, test_acc = _evaluate(w, setup)
+    return _result(train_loss, test_loss, test_acc)
+
+
+def Distributed(setup, lr=0.01, epoch=200, batch_size=32, prox=False, mu=0.1,
+                lambda_reg_if=False, lambda_reg=0.01, seed=0,
+                sequential=False, **_):
+    g = torch.Generator().manual_seed(seed)
+    stacked, losses, _ = _client_pass(
+        setup, _init_weights(setup, seed), lr, epoch, batch_size,
+        mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0, g,
+        sequential,
+    )
+    p = setup.p_fixed
+    w = _weighted_average(stacked, p)
+    test_loss, test_acc = _evaluate(w, setup)
+    return _result(float((p * losses).sum()), test_loss, test_acc)
+
+
+def FedAMW_OneShot(setup, lr=0.01, epoch=200, batch_size=32, prox=False,
+                   mu=0.1, lambda_reg_if=True, lambda_reg=0.01, round=100,
+                   lr_p=5e-5, val_batch_size=16, seed=0, sequential=False, **_):
+    g = torch.Generator().manual_seed(seed)
+    stacked, losses, _ = _client_pass(
+        setup, _init_weights(setup, seed), lr, epoch, batch_size,
+        mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0, g,
+        sequential,
+    )
+    p = setup.p_fixed
+    train_loss = float((p * losses).sum())
+    with torch.no_grad():
+        logits = torch.einsum("jcd,nd->njc", stacked, setup.X_val)
+    buf = torch.zeros_like(p)
+    test_loss = np.zeros(round)
+    test_acc = np.zeros(round)
+    for t in range(round):
+        p, buf = _solve_p(logits, setup.y_val, p, buf, lr_p, 0.0,
+                          val_batch_size, 1, setup.task, g)
+        w = _weighted_average(stacked, p)
+        test_loss[t], test_acc[t] = _evaluate(w, setup)
+    return _result(train_loss, test_loss, test_acc)
+
+
+def _rounds(setup, aggregation, lr, epoch, batch_size, rounds, mu, lam,
+            lr_p=5e-5, val_batch_size=16, seed=0, lr_mode="reference",
+            sequential=False):
+    g = torch.Generator().manual_seed(seed)
+    w = _init_weights(setup, seed)
+    p = setup.p_fixed
+    lrs = lr_schedule_array(lr, rounds, lr_mode)
+    if aggregation == "nova":
+        tau = torch.tensor(setup.sizes * epoch / batch_size, dtype=torch.float32)
+        agg_w = p * (tau * p).sum() / tau
+    else:
+        agg_w = p
+    buf = torch.zeros_like(p)
+    train_loss = np.zeros(rounds)
+    test_loss = np.zeros(rounds)
+    test_acc = np.zeros(rounds)
+    for t in range(rounds):
+        stacked, losses, _ = _client_pass(
+            setup, w, float(lrs[t]), epoch, batch_size, mu, lam, g, sequential
+        )
+        train_loss[t] = float((p * losses).sum())
+        if aggregation == "learned":
+            with torch.no_grad():
+                logits = torch.einsum("jcd,nd->njc", stacked, setup.X_val)
+            p, buf = _solve_p(logits, setup.y_val, p, buf, lr_p, 0.9,
+                              val_batch_size, rounds, setup.task, g)
+            w = _weighted_average(stacked, p)
+        else:
+            w = _weighted_average(stacked, agg_w)
+        test_loss[t], test_acc[t] = _evaluate(w, setup)
+    return _result(train_loss, test_loss, test_acc)
+
+
+def FedAvg(setup, lr=0.01, epoch=2, batch_size=32, prox=False, mu=0.1,
+           lambda_reg_if=False, lambda_reg=0.01, round=100, seed=0,
+           lr_mode="reference", sequential=False, **_):
+    return _rounds(setup, "fixed", lr, epoch, batch_size, round,
+                   mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
+                   seed=seed, lr_mode=lr_mode, sequential=sequential)
+
+
+def FedProx(setup, lr=0.01, epoch=2, batch_size=32, prox=True, mu=0.1,
+            lambda_reg_if=False, lambda_reg=0.01, round=100, seed=0,
+            lr_mode="reference", sequential=False, **_):
+    return _rounds(setup, "fixed", lr, epoch, batch_size, round,
+                   mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
+                   seed=seed, lr_mode=lr_mode, sequential=sequential)
+
+
+def FedNova(setup, lr=0.01, epoch=2, batch_size=32, prox=False, mu=0.1,
+            lambda_reg_if=False, lambda_reg=0.01, round=100, seed=0,
+            lr_mode="reference", sequential=False, **_):
+    return _rounds(setup, "nova", lr, epoch, batch_size, round,
+                   mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
+                   seed=seed, lr_mode=lr_mode, sequential=sequential)
+
+
+def FedAMW(setup, lr=0.01, epoch=2, batch_size=32, prox=False, mu=0.1,
+           lambda_reg_if=True, lambda_reg=0.01, round=100, lr_p=5e-5,
+           val_batch_size=16, seed=0, lr_mode="reference",
+           sequential=False, **_):
+    return _rounds(setup, "learned", lr, epoch, batch_size, round,
+                   mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
+                   lr_p=lr_p, val_batch_size=val_batch_size, seed=seed,
+                   lr_mode=lr_mode, sequential=sequential)
+
+
+def _result(train_loss, test_loss, test_acc):
+    return {
+        "train_loss": np.asarray(train_loss),
+        "test_loss": np.asarray(test_loss),
+        "test_acc": np.asarray(test_acc),
+    }
+
+
+ALGORITHMS = {
+    "Centralized": Centralized,
+    "Distributed": Distributed,
+    "FedAMW_OneShot": FedAMW_OneShot,
+    "FedAvg": FedAvg,
+    "FedProx": FedProx,
+    "FedNova": FedNova,
+    "FedAMW": FedAMW,
+}

@@ -71,3 +71,23 @@ def data_heterogeneity(X: jax.Array, idx: jax.Array, mask: jax.Array, block: int
         return mask_j.sum() / n * jnp.linalg.norm(C - Cj)
 
     return jax.lax.map(lambda args: per_client(*args), (idx, mask)).sum()
+
+
+def heterogeneity_from_parts(X, parts) -> float:
+    """Backend-agnostic heterogeneity on FULL client partitions.
+
+    The reference computes the score before the 80/20 val split
+    (``exp.py:66-76`` precedes the split at ``exp.py:80-99``), so the
+    weights n_j/n sum to 1 over all rows. Accepts numpy/torch/jax X and
+    ragged index arrays; packs them and reuses ``data_heterogeneity``.
+    """
+    import numpy as np
+
+    X = jnp.asarray(np.asarray(X))
+    n_max = max(len(p) for p in parts)
+    idx = np.zeros((len(parts), n_max), np.int32)
+    mask = np.zeros((len(parts), n_max), np.float32)
+    for j, p in enumerate(parts):
+        idx[j, : len(p)] = np.asarray(p)
+        mask[j, : len(p)] = 1.0
+    return float(data_heterogeneity(X, jnp.asarray(idx), jnp.asarray(mask)))

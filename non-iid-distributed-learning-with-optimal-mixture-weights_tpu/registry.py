@@ -1,0 +1,42 @@
+"""Backend gate: one function registry, two execution paths.
+
+The BASELINE.json north star requires the new backend to be "gated
+behind the existing tools.py function registry so exp.py and the
+nni/tune.py hyperparameter loop call either the PyTorch or the JAX path
+unchanged". Drivers do exactly that:
+
+    backend = registry.get_backend("jax" | "torch")
+    setup = backend.prepare_setup(dataset, D=..., kernel_par=...)
+    fn = backend.ALGORITHMS["FedAMW"]
+    result = fn(setup, lr=..., round=..., lr_p=...)
+
+Both backends expose the same algorithm names (the reference's import
+surface, ``exp.py:4``), the same keyword surface, and the same result
+dict schema.
+"""
+
+from __future__ import annotations
+
+from types import ModuleType
+
+BACKENDS = ("jax", "torch")
+
+
+def get_backend(name: str = "jax") -> ModuleType:
+    if name == "jax":
+        from . import algorithms
+
+        return algorithms
+    if name == "torch":
+        from .backends import torch_ref
+
+        return torch_ref
+    raise ValueError(f"unknown backend {name!r}; choose from {BACKENDS}")
+
+
+def get_algorithm(name: str, backend: str = "jax"):
+    """Reference-style lookup: ``get_algorithm('FedAvg', 'jax')``."""
+    algos = get_backend(backend).ALGORITHMS
+    if name not in algos:
+        raise ValueError(f"unknown algorithm {name!r}; choose from {sorted(algos)}")
+    return algos[name]

@@ -1,0 +1,55 @@
+"""Driver smoke tests: exp.py and tune.py run end-to-end as subprocesses."""
+
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, cwd):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = env.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = REPO
+    return subprocess.run(
+        [sys.executable] + args, cwd=cwd, env=env,
+        capture_output=True, text=True, timeout=600,
+    )
+
+
+@pytest.mark.parametrize("backend", ["jax", "torch"])
+def test_exp_driver(tmp_path, backend):
+    out = _run(
+        [os.path.join(REPO, "exp.py"), "--dataset", "digits",
+         "--backend", backend, "--D", "128", "--num_partitions", "4",
+         "--round", "3", "--local_epoch", "1",
+         "--result_dir", str(tmp_path)],
+        cwd=str(tmp_path),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    with open(tmp_path / "exp1_digits.pkl", "rb") as f:
+        data = pickle.load(f)
+    # reference result schema (exp.py:132-143)
+    assert data["name"] == ["CL", "DL", "FedAMW_OneShot", "FedAvg",
+                            "FedProx", "FedAMW"]
+    assert data["train_loss"].shape == (6, 3, 1)
+    assert data["test_acc"].shape == (6, 3, 1)
+    assert data["heterogeneity"].shape == (1,)
+    assert np.all(np.isfinite(data["test_acc"]))
+
+
+def test_tune_driver_standalone(tmp_path):
+    out = _run(
+        [os.path.join(REPO, "tune.py"), "--dataset", "digits",
+         "--D", "128", "--round", "3", "--local_epoch", "1",
+         "--lr_p", "0.001", "--lambda_reg", "0.00005"],
+        cwd=str(tmp_path),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "FedAMW final" in out.stdout

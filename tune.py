@@ -1,0 +1,109 @@
+"""Hyperparameter-tuning trial driver (NNI-compatible).
+
+Reproduces the reference ``tune.py`` (``/root/reference/tune.py``): one
+trial = merge tuner-proposed parameters over argparse defaults (same
+flag surface, ``tune.py:140-165``), run FedAMW, report the final
+accuracy. NNI is import-gated — without it (as on this box) the script
+runs standalone with CLI flags and prints the metric, so the same file
+serves both ``nnictl create --config config.yml`` and manual sweeps.
+The execution backend is selected with ``--backend`` via the registry.
+"""
+
+import argparse
+import logging
+
+import numpy as np
+
+try:
+    import nni
+    from nni.utils import merge_parameter
+
+    HAS_NNI = True
+except ImportError:  # tuner not installed: standalone mode
+    HAS_NNI = False
+
+logger = logging.getLogger("Tune Hyperparameters")
+
+
+def get_params():
+    ap = argparse.ArgumentParser(description="Tuner")
+    ap.add_argument("--seed", type=int, default=1, metavar="S")
+    ap.add_argument("--dataset", type=str, default="usps")
+    ap.add_argument("--backend", type=str, default="jax", choices=["jax", "torch"])
+    ap.add_argument("--alpha", type=float, default=0.0,
+                    help="data heterogeneity parameter (synthetic)")
+    ap.add_argument("--beta", type=float, default=0.0,
+                    help="model heterogeneity (synthetic)")
+    ap.add_argument("--D", type=int, default=2000, metavar="N")
+    ap.add_argument("--kernel_par", type=float, default=0.1)
+    ap.add_argument("--lambda_reg_os", type=float, default=0.000001)
+    ap.add_argument("--lambda_reg", type=float, default=0.000001)
+    ap.add_argument("--lambda_prox", type=float, default=0.01)
+    ap.add_argument("--data_dir", type=str, default="datasets")
+    ap.add_argument("--lr", type=float, default=0.5, metavar="LR")
+    ap.add_argument("--lr_p", type=float, default=0.1, metavar="LR_p")
+    ap.add_argument("--lr_p_os", type=float, default=0.1, metavar="LR_p")
+    ap.add_argument("--local_epoch", type=int, default=2)
+    ap.add_argument("--round", type=int, default=100, metavar="N")
+    args, _ = ap.parse_known_args()
+    return args
+
+
+def main(args):
+    from fedamw_tpu.config import get_parameter
+    from fedamw_tpu.data import load_dataset
+    from fedamw_tpu.registry import get_backend
+
+    dataset = args["dataset"]
+    registry_params = get_parameter(dataset)
+    num_partitions = 50
+    batch_size = 32
+    alpha_dirk = 0.01
+
+    rng = np.random.RandomState(args["seed"])
+    ds = load_dataset(
+        dataset, num_partitions, alpha_dirk,
+        data_dir=args["data_dir"], rng=rng,
+    )
+    backend = get_backend(args["backend"])
+    setup = backend.prepare_setup(
+        ds,
+        D=args["D"],
+        kernel_par=registry_params["kernel_par"],
+        kernel_type=registry_params["kernel_type"],
+        seed=args["seed"],
+        rng=rng,
+    )
+    res = backend.ALGORITHMS["FedAMW"](
+        setup,
+        lr=registry_params["lr"],
+        epoch=int(args["local_epoch"]),
+        batch_size=batch_size,
+        lambda_reg_if=True,
+        lambda_reg=args["lambda_reg"],
+        round=args["round"],
+        lr_p=args["lr_p"],
+        seed=args["seed"],
+    )
+    acc = float(res["test_acc"][-1])
+    loss = float(res["test_loss"][-1])
+    logger.info("FedAMW --- Error: %.5f Acc: %.5f", loss, acc)
+    print(f"FedAMW final: loss={loss:.5f} acc={acc:.5f}")
+    if HAS_NNI:
+        nni.report_final_result(acc)
+    return acc
+
+
+if __name__ == "__main__":
+    try:
+        if HAS_NNI:
+            tuner_params = nni.get_next_parameter()
+            logger.debug(tuner_params)
+            params = vars(merge_parameter(get_params(), tuner_params))
+        else:
+            params = vars(get_params())
+        print(params)
+        main(params)
+    except Exception as exc:
+        logger.exception(exc)
+        raise
