@@ -1,0 +1,134 @@
+// Fast LIBSVM/svmlight-format parser.
+//
+// The data-loading path is the one place this framework keeps native
+// code (the reference is pure Python; its sklearn parser is the
+// slowest part of startup for the larger LIBSVM sets). Two-pass over a
+// single mmap-read buffer: pass 1 counts rows and the max feature
+// index, pass 2 fills a dense row-major float32 matrix. Exposed with a
+// C ABI for ctypes (no pybind11 in this image).
+//
+// Format per line:  <label> [<index>:<value> ...]   (1-based indices)
+// Comments (#...) and blank lines are skipped, matching sklearn.
+
+#include <cctype>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Buffer {
+    std::string data;
+    bool ok = false;
+};
+
+Buffer read_file(const char* path) {
+    Buffer buf;
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return buf;
+    std::fseek(f, 0, SEEK_END);
+    long size = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    buf.data.resize(static_cast<size_t>(size));
+    size_t got = size ? std::fread(&buf.data[0], 1, static_cast<size_t>(size), f) : 0;
+    std::fclose(f);
+    buf.ok = (static_cast<long>(got) == size);
+    return buf;
+}
+
+inline const char* skip_ws(const char* p, const char* end) {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+    return p;
+}
+
+inline const char* line_end(const char* p, const char* end) {
+    while (p < end && *p != '\n') ++p;
+    return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success. Caller frees *out_x / *out_y with svmlight_free.
+//   out_x: rows*cols dense row-major float32
+//   out_y: rows float64 labels
+int svmlight_parse(const char* path, float** out_x, double** out_y,
+                   long* out_rows, long* out_cols) {
+    Buffer buf = read_file(path);
+    if (!buf.ok) return 1;
+    const char* p = buf.data.data();
+    const char* end = p + buf.data.size();
+
+    // Pass 1: rows + max feature index.
+    long rows = 0, max_idx = 0;
+    for (const char* q = p; q < end;) {
+        const char* eol = line_end(q, end);
+        const char* s = skip_ws(q, eol);
+        if (s < eol && *s != '#') {
+            ++rows;
+            // scan for "index:" tokens
+            for (const char* t = s; t < eol; ++t) {
+                if (*t == ':') {
+                    const char* d = t;
+                    while (d > s && std::isdigit(*(d - 1))) --d;
+                    if (d < t) {
+                        long idx = std::strtol(d, nullptr, 10);
+                        if (idx > max_idx) max_idx = idx;
+                    }
+                }
+            }
+        }
+        q = eol + 1;
+    }
+    if (rows == 0) return 2;
+
+    long cols = max_idx;  // 1-based indices
+    float* X = static_cast<float*>(std::calloc(static_cast<size_t>(rows) * cols,
+                                               sizeof(float)));
+    double* y = static_cast<double*>(std::malloc(rows * sizeof(double)));
+    if (!X || !y) {
+        std::free(X);
+        std::free(y);
+        return 3;
+    }
+
+    // Pass 2: fill.
+    long r = 0;
+    for (const char* q = p; q < end;) {
+        const char* eol = line_end(q, end);
+        const char* s = skip_ws(q, eol);
+        if (s < eol && *s != '#') {
+            char* next = nullptr;
+            y[r] = std::strtod(s, &next);
+            const char* t = next;
+            while (t < eol) {
+                t = skip_ws(t, eol);
+                if (t >= eol || *t == '#') break;
+                long idx = std::strtol(t, &next, 10);
+                if (next >= eol || *next != ':') break;
+                double val = std::strtod(next + 1, &next);
+                if (idx >= 1 && idx <= cols)
+                    X[r * cols + (idx - 1)] = static_cast<float>(val);
+                t = next;
+            }
+            ++r;
+        }
+        q = eol + 1;
+    }
+
+    *out_x = X;
+    *out_y = y;
+    *out_rows = rows;
+    *out_cols = cols;
+    return 0;
+}
+
+void svmlight_free(float* x, double* y) {
+    std::free(x);
+    std::free(y);
+}
+
+}  // extern "C"

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data import FederatedDataset, pack_partitions, split_train_val
+from ..data.pack import bucket_partitions
 from ..models import Model, get_model
 from ..ops.rff import rff_map, rff_params
 
@@ -35,22 +36,49 @@ class FedSetup:
     y_test: jax.Array
     X_val: jax.Array            # pooled validation (n_val, D)
     y_val: jax.Array
-    idx: jax.Array              # (J, n_max) client row indices
-    mask: jax.Array             # (J, n_max)
+    idx: jax.Array | None       # (J, n_max) client row indices (None when bucketed)
+    mask: jax.Array | None      # (J, n_max)
     sizes: jax.Array            # (J,) true client sizes
     p_fixed: jax.Array          # (J,) sample-count mixture weights (ClientPack.weights)
     rff: tuple | None = None    # (W, b) draw, for mapping new data
+    # Size-bucketed view (prepare_setup(buckets>1)): clients sorted by
+    # size desc; all client-indexed arrays above use that same order.
+    bucket_idx: tuple | None = None   # tuple of (J_g, n_max_g) arrays
+    bucket_mask: tuple | None = None
 
     @property
     def num_clients(self) -> int:
-        return int(self.idx.shape[0])
+        return int(self.sizes.shape[0])
+
+    @property
+    def n_maxes(self) -> tuple[int, ...]:
+        """Per-bucket padded capacities (single-bucket when unbucketed)."""
+        if self.bucket_idx is None:
+            return (int(self.idx.shape[1]),)
+        return tuple(int(b.shape[1]) for b in self.bucket_idx)
+
+    @property
+    def bucket_counts(self) -> tuple[int, ...]:
+        if self.bucket_idx is None:
+            return (self.num_clients,)
+        return tuple(int(b.shape[0]) for b in self.bucket_idx)
+
+    def round_arrays(self) -> tuple[tuple, tuple]:
+        """(idx_tuple, mask_tuple) for fedcore.make_bucketed_round."""
+        if self.bucket_idx is None:
+            return (self.idx,), (self.mask,)
+        return self.bucket_idx, self.bucket_mask
 
     @property
     def all_train_idx(self) -> jax.Array:
         """One flat index set of every valid train row (for Centralized)."""
-        flat = np.asarray(self.idx).reshape(-1)
-        keep = np.asarray(self.mask).reshape(-1) > 0
-        return jnp.asarray(flat[keep], dtype=jnp.int32)
+        idx_tup, mask_tup = self.round_arrays()
+        chunks = []
+        for idx_g, mask_g in zip(idx_tup, mask_tup):
+            flat = np.asarray(idx_g).reshape(-1)
+            keep = np.asarray(mask_g).reshape(-1) > 0
+            chunks.append(flat[keep])
+        return jnp.asarray(np.concatenate(chunks), dtype=jnp.int32)
 
 
 def prepare_setup(
@@ -64,6 +92,7 @@ def prepare_setup(
     rng: np.random.RandomState | None = None,
     pad_clients_to: int | None = None,
     n_max: int | None = None,
+    buckets: int = 1,
 ) -> FedSetup:
     """Build the device-resident setup from a loaded dataset.
 
@@ -72,6 +101,11 @@ def prepare_setup(
     ``seed`` drives the RFF draw via ``jax.random`` (torch's global RNG
     in the reference — bitwise parity across frameworks is impossible, so
     parity here is statistical; SURVEY.md §2.3.4).
+
+    ``buckets > 1`` enables size-bucketed client packing (clients sorted
+    by size descending; every client-indexed array uses that order) —
+    the padding-waste killer for heavy Dirichlet skew. Incompatible with
+    ``pad_clients_to``/mesh sharding for now.
     """
     if rng is None:
         rng = np.random.RandomState(seed)
@@ -92,7 +126,27 @@ def prepare_setup(
         feat_dim = ds.d
 
     train_parts, val_idx = split_train_val(ds.parts, val_fraction, rng)
-    pack = pack_partitions(train_parts, n_max=n_max, pad_clients_to=pad_clients_to)
+
+    bucket_idx = bucket_mask = None
+    if buckets > 1:
+        if pad_clients_to is not None:
+            raise ValueError("buckets>1 is incompatible with pad_clients_to")
+        packs, order = bucket_partitions(train_parts, buckets)
+        train_parts = [train_parts[i] for i in order]  # sorted-by-size order
+        bucket_idx = tuple(jnp.asarray(p.idx) for p in packs)
+        bucket_mask = tuple(jnp.asarray(p.mask) for p in packs)
+        # No globally-padded (J, N_max_global) pack: the bucketed view is
+        # the whole point — derive sizes/weights directly.
+        sizes = np.array([len(p) for p in train_parts], dtype=np.int32)
+        weights = (sizes.astype(np.float64) / sizes.sum()).astype(np.float32)
+        idx_full = mask_full = None
+    else:
+        pack = pack_partitions(
+            train_parts, n_max=n_max, pad_clients_to=pad_clients_to
+        )
+        sizes, weights = pack.sizes, pack.weights
+        idx_full = jnp.asarray(pack.idx)
+        mask_full = jnp.asarray(pack.mask)
 
     y = jnp.asarray(ds.y_train)
     return FedSetup(
@@ -106,11 +160,13 @@ def prepare_setup(
         y_test=jnp.asarray(ds.y_test),
         X_val=X_train[jnp.asarray(val_idx, dtype=jnp.int32)],
         y_val=y[jnp.asarray(val_idx, dtype=jnp.int32)],
-        idx=jnp.asarray(pack.idx),
-        mask=jnp.asarray(pack.mask),
-        sizes=jnp.asarray(pack.sizes),
-        p_fixed=jnp.asarray(pack.weights),
+        idx=idx_full,
+        mask=mask_full,
+        sizes=jnp.asarray(sizes),
+        p_fixed=jnp.asarray(weights),
         rff=rff,
+        bucket_idx=bucket_idx,
+        bucket_mask=bucket_mask,
     )
 
 

@@ -25,13 +25,15 @@ documented and switchable where meaningful):
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
 from ..fedcore import (
     client_logits,
     fednova_effective_weights,
-    make_client_round,
+    make_bucketed_round,
     make_evaluator,
     make_local_update,
     make_p_solver,
@@ -51,6 +53,111 @@ def _init_params(setup: FedSetup, seed: int):
     )
 
 
+# All kernel factories below are memoized on their static configuration.
+# jit caches by function identity — rebuilding a closure per algorithm
+# call would recompile the whole round scan every time (and the first
+# "warmup" call would cache nothing).
+
+_cached_local_update = functools.lru_cache(maxsize=128)(
+    lambda apply_fn, task, epochs, batch_size, n: jax.jit(
+        make_local_update(apply_fn, task, epochs, batch_size, n)
+    )
+)
+
+_cached_bucketed_round = functools.lru_cache(maxsize=128)(
+    lambda apply_fn, task, epochs, batch_size, n_maxes, counts,
+    sequential=False: jax.jit(
+        make_bucketed_round(
+            apply_fn, task, epochs, batch_size, n_maxes, counts, sequential
+        )
+    )
+)
+
+_cached_evaluator = functools.lru_cache(maxsize=32)(make_evaluator)
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_oneshot_p_phase(apply_fn, task, n_val, val_batch_size, lr_p):
+    """Jitted one-shot mixture phase: per iteration one p-epoch (plain
+    SGD), re-aggregate, eval."""
+    solve, init_opt = make_p_solver(task, n_val, val_batch_size, lr_p,
+                                    momentum=0.0)
+    evaluate = make_evaluator(apply_fn, task)
+
+    @jax.jit
+    def p_phase(p, opt_state, logits, stacked, y_val, X_test, y_test, pkeys):
+        def body(carry, key_t):
+            p, opt_state = carry
+            p, opt_state, _, _ = solve(logits, y_val, p, opt_state, key_t, 1)
+            g = weighted_average(stacked, p)
+            tl, ta = evaluate(g, X_test, y_test)
+            return (p, opt_state), (tl, ta)
+
+        (p, opt_state), (tls, tas) = jax.lax.scan(body, (p, opt_state), pkeys)
+        return p, tls, tas
+
+    return p_phase, init_opt
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_round_trainer(apply_fn, task, epoch, batch_size, n_maxes, counts,
+                          rounds, aggregation, lr_p, val_batch_size, n_val,
+                          sequential):
+    """The full jitted training run for the round-based algorithms: one
+    lax.scan over rounds. Memoized so repeated runs (sweeps, benchmarks,
+    NNI trials) reuse the compiled program."""
+    round_fn = make_bucketed_round(apply_fn, task, epoch, batch_size,
+                                   n_maxes, counts, sequential=sequential)
+    evaluate = make_evaluator(apply_fn, task)
+
+    if aggregation == "learned":
+        solve, init_opt = make_p_solver(task, n_val, val_batch_size, lr_p,
+                                        momentum=0.9)
+
+        @jax.jit
+        def train(params, p, opt_state, X, y, idx, mask, X_val, y_val,
+                  X_test, y_test, lrs, keys, pkeys, mu, lam):
+            def body(carry, inp):
+                params, p, opt_state = carry
+                lr_t, keys_t, pkey_t = inp
+                stacked, losses, _ = round_fn(
+                    params, X, y, idx, mask, keys_t, lr_t, mu, lam,
+                )
+                train_loss_t = jnp.sum(p * losses)  # current p (tools.py:434)
+                logits = client_logits(apply_fn, stacked, X_val)
+                p, opt_state, _, _ = solve(
+                    logits, y_val, p, opt_state, pkey_t, rounds
+                )
+                params = weighted_average(stacked, p)
+                tl, ta = evaluate(params, X_test, y_test)
+                return (params, p, opt_state), (train_loss_t, tl, ta)
+
+            (params, p, opt_state), metrics = jax.lax.scan(
+                body, (params, p, opt_state), (lrs, keys, pkeys)
+            )
+            return metrics
+
+        return train, init_opt
+
+    @jax.jit
+    def train(params, X, y, idx, mask, X_test, y_test, lrs, keys,
+              p_fixed, agg_w, mu, lam):
+        def body(params, inp):
+            lr_t, keys_t = inp
+            stacked, losses, _ = round_fn(
+                params, X, y, idx, mask, keys_t, lr_t, mu, lam,
+            )
+            train_loss_t = jnp.sum(p_fixed * losses)
+            params = weighted_average(stacked, agg_w)
+            tl, ta = evaluate(params, X_test, y_test)
+            return params, (train_loss_t, tl, ta)
+
+        _, metrics = jax.lax.scan(body, params, (lrs, keys))
+        return metrics
+
+    return train, None
+
+
 def Centralized(
     setup: FedSetup,
     lr=0.01,
@@ -63,9 +170,7 @@ def Centralized(
     (reference ``tools.py:240-255``; called with epoch*Round epochs)."""
     all_idx = setup.all_train_idx
     n = int(all_idx.shape[0])
-    lu = jax.jit(
-        make_local_update(setup.model.apply, setup.task, epoch, batch_size, n)
-    )
+    lu = _cached_local_update(setup.model.apply, setup.task, epoch, batch_size, n)
     params = _init_params(setup, seed)
     params, train_loss, _ = lu(
         params,
@@ -78,28 +183,28 @@ def Centralized(
         jnp.float32(0.0),
         jnp.float32(0.0),
     )
-    evaluate = make_evaluator(setup.model.apply, setup.task)
+    evaluate = _cached_evaluator(setup.model.apply, setup.task)
     test_loss, test_acc = evaluate(params, setup.X_test, setup.y_test)
     return result_tuple(train_loss, test_loss, test_acc)
 
 
-def _one_shot_local_phase(setup, lr, epoch, batch_size, mu, lam, seed):
+def _one_shot_local_phase(setup, lr, epoch, batch_size, mu, lam, seed,
+                          sequential=False):
     """Shared by Distributed and FedAMW_OneShot: every client trains
     epoch*Round epochs from the same init, once."""
-    n_max = int(setup.idx.shape[1])
-    round_fn = jax.jit(
-        make_client_round(
-            setup.model.apply, setup.task, epoch, batch_size, n_max
-        )
+    round_fn = _cached_bucketed_round(
+        setup.model.apply, setup.task, epoch, batch_size,
+        setup.n_maxes, setup.bucket_counts, sequential,
     )
     params = _init_params(setup, seed)
     keys = _keys(seed, setup.num_clients)
+    idx_tup, mask_tup = setup.round_arrays()
     stacked, losses, accs = round_fn(
         params,
         setup.X,
         setup.y,
-        setup.idx,
-        setup.mask,
+        idx_tup,
+        mask_tup,
         keys,
         jnp.float32(lr),
         jnp.float32(mu),
@@ -118,17 +223,19 @@ def Distributed(
     lambda_reg_if=False,
     lambda_reg=0.01,
     seed=0,
+    sequential=False,
     **_,
 ):
     """One-shot FL with fixed sample-count weights (``tools.py:258-276``)."""
     stacked, losses = _one_shot_local_phase(
         setup, lr, epoch, batch_size,
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0, seed,
+        sequential=sequential,
     )
     p = setup.p_fixed
     train_loss = jnp.sum(p * losses)
     global_params = weighted_average(stacked, p)
-    evaluate = make_evaluator(setup.model.apply, setup.task)
+    evaluate = _cached_evaluator(setup.model.apply, setup.task)
     test_loss, test_acc = evaluate(global_params, setup.X_test, setup.y_test)
     return result_tuple(train_loss, test_loss, test_acc)
 
@@ -146,6 +253,7 @@ def FedAMW_OneShot(
     lr_p=5e-5,
     val_batch_size=16,
     seed=0,
+    sequential=False,
     **_,
 ):
     """One long local phase, then ``round`` iterations of mixture-weight
@@ -156,35 +264,23 @@ def FedAMW_OneShot(
     stacked, losses = _one_shot_local_phase(
         setup, lr, epoch, batch_size,
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0, seed,
+        sequential=sequential,
     )
     p0 = setup.p_fixed
     train_loss = jnp.sum(p0 * losses)
 
     n_val = int(setup.X_val.shape[0])
-    solve, init_opt = make_p_solver(
-        setup.task, n_val, val_batch_size, lr_p, momentum=0.0
+    p_phase, init_opt = _cached_oneshot_p_phase(
+        setup.model.apply, setup.task, n_val, val_batch_size, lr_p
     )
-    evaluate = make_evaluator(setup.model.apply, setup.task)
-    logits = client_logits(setup.model.apply, stacked, setup.X_val)
+    logits = jax.jit(client_logits, static_argnums=0)(
+        setup.model.apply, stacked, setup.X_val
+    )
     pkeys = _keys(seed + 1, round)
-
-    @jax.jit
-    def p_phase(p, opt_state):
-        def body(carry, key_t):
-            p, opt_state = carry
-            p, opt_state, _, _ = solve(
-                logits, setup.y_val, p, opt_state, key_t, 1
-            )
-            g = weighted_average(stacked, p)
-            tl, ta = evaluate(g, setup.X_test, setup.y_test)
-            return (p, opt_state), (tl, ta)
-
-        (p, opt_state), (tls, tas) = jax.lax.scan(
-            body, (p, opt_state), pkeys
-        )
-        return p, tls, tas
-
-    _, test_loss, test_acc = p_phase(p0, init_opt(p0))
+    _, test_loss, test_acc = p_phase(
+        p0, init_opt(p0), logits, stacked, setup.y_val,
+        setup.X_test, setup.y_test, pkeys,
+    )
     return result_tuple(train_loss, test_loss, test_acc)
 
 
@@ -204,77 +300,46 @@ def _round_based(
     sequential=False,
 ):
     """Common skeleton of FedAvg/FedProx/FedNova/FedAMW: scan over rounds
-    of {local updates -> aggregate -> eval} (``tools.py:337-352``)."""
-    n_max = int(setup.idx.shape[1])
-    round_fn = make_client_round(
-        setup.model.apply, setup.task, epoch, batch_size, n_max,
-        sequential=sequential,
-    )
-    evaluate = make_evaluator(setup.model.apply, setup.task)
+    of {local updates -> aggregate -> eval} (``tools.py:337-352``).
+
+    Every array is an explicit jit argument — a closure-captured device
+    array would be baked into the HLO as a literal constant (hundreds of
+    MB for the feature matrix), bloating compile payloads. The jitted
+    trainer itself is memoized on the static config.
+    """
+    n_val = int(setup.X_val.shape[0])
     lrs = jnp.asarray(lr_schedule_array(lr, rounds, lr_mode))
     keys = _keys(seed, rounds, setup.num_clients)
     params0 = _init_params(setup, seed)
     p_fixed = setup.p_fixed
+    idx_tup, mask_tup = setup.round_arrays()
     mu = jnp.float32(mu)
     lam = jnp.float32(lam)
 
-    if aggregation == "nova":
-        agg_w = fednova_effective_weights(
-            setup.sizes, p_fixed, epoch, batch_size
-        )
-    else:
-        agg_w = p_fixed
+    train, init_opt = _cached_round_trainer(
+        setup.model.apply, setup.task, epoch, batch_size,
+        setup.n_maxes, setup.bucket_counts, rounds,
+        aggregation, lr_p, val_batch_size, n_val, sequential,
+    )
 
     if aggregation == "learned":
-        n_val = int(setup.X_val.shape[0])
-        solve, init_opt = make_p_solver(
-            setup.task, n_val, val_batch_size, lr_p, momentum=0.9
-        )
         pkeys = _keys(seed + 1, rounds)
-
-        @jax.jit
-        def train(params, p, opt_state):
-            def body(carry, inp):
-                params, p, opt_state = carry
-                lr_t, keys_t, pkey_t = inp
-                stacked, losses, _ = round_fn(
-                    params, setup.X, setup.y, setup.idx, setup.mask,
-                    keys_t, lr_t, mu, lam,
-                )
-                train_loss_t = jnp.sum(p * losses)  # current p (tools.py:434)
-                logits = client_logits(setup.model.apply, stacked, setup.X_val)
-                p, opt_state, _, _ = solve(
-                    logits, setup.y_val, p, opt_state, pkey_t, rounds
-                )
-                params = weighted_average(stacked, p)
-                tl, ta = evaluate(params, setup.X_test, setup.y_test)
-                return (params, p, opt_state), (train_loss_t, tl, ta)
-
-            (params, p, opt_state), metrics = jax.lax.scan(
-                body, (params, p, opt_state), (lrs, keys, pkeys)
-            )
-            return metrics
-
-        metrics = train(params0, p_fixed, init_opt(p_fixed))
+        metrics = train(
+            params0, p_fixed, init_opt(p_fixed), setup.X, setup.y,
+            idx_tup, mask_tup, setup.X_val, setup.y_val,
+            setup.X_test, setup.y_test, lrs, keys, pkeys, mu, lam,
+        )
     else:
-
-        @jax.jit
-        def train(params):
-            def body(params, inp):
-                lr_t, keys_t = inp
-                stacked, losses, _ = round_fn(
-                    params, setup.X, setup.y, setup.idx, setup.mask,
-                    keys_t, lr_t, mu, lam,
-                )
-                train_loss_t = jnp.sum(p_fixed * losses)
-                params = weighted_average(stacked, agg_w)
-                tl, ta = evaluate(params, setup.X_test, setup.y_test)
-                return params, (train_loss_t, tl, ta)
-
-            _, metrics = jax.lax.scan(body, params, (lrs, keys))
-            return metrics
-
-        metrics = train(params0)
+        if aggregation == "nova":
+            agg_w = fednova_effective_weights(
+                setup.sizes, p_fixed, epoch, batch_size
+            )
+        else:
+            agg_w = p_fixed
+        metrics = train(
+            params0, setup.X, setup.y, idx_tup, mask_tup,
+            setup.X_test, setup.y_test, lrs, keys, p_fixed, agg_w, mu, lam,
+        )
 
     return result_tuple(*metrics)
 

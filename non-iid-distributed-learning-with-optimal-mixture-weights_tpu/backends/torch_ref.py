@@ -133,6 +133,8 @@ def _local_sgd(w0, setup, part, lr, epochs, batch_size, mu, lam, generator):
     w = w0.clone().requires_grad_(True)
     anchor = w0.clone()
     n = len(part)
+    if n == 0:  # padded/empty client: inert (matches the JAX kernel)
+        return w0.clone(), 0.0, 0.0
     ep_loss = ep_acc = 0.0
     for _ in range(epochs):
         order = part[torch.randperm(n, generator=generator)]
@@ -271,7 +273,10 @@ def _rounds(setup, aggregation, lr, epoch, batch_size, rounds, mu, lam,
     lrs = lr_schedule_array(lr, rounds, lr_mode)
     if aggregation == "nova":
         tau = torch.tensor(setup.sizes * epoch / batch_size, dtype=torch.float32)
-        agg_w = p * (tau * p).sum() / tau
+        # empty clients (tau=0, p=0) stay inert instead of poisoning 0/0
+        safe_tau = torch.where(tau > 0, tau, torch.ones_like(tau))
+        agg_w = torch.where(tau > 0, p * (tau * p).sum() / safe_tau,
+                            torch.zeros_like(p))
     else:
         agg_w = p
     buf = torch.zeros_like(p)

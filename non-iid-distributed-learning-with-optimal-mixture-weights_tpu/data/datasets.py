@@ -68,6 +68,7 @@ def load_dataset(
     rng: np.random.RandomState | None = None,
     synthetic_seed: int = 11,
     verbose: bool = False,
+    min_size: int = 10,
 ) -> FederatedDataset:
     """Load + partition a dataset into simulated non-IID clients.
 
@@ -118,7 +119,8 @@ def load_dataset(
 
     if alpha != -1:
         parts, class_counts = dirichlet_partition(
-            y_train, num_partitions, alpha, seed=partition_seed, verbose=verbose
+            y_train, num_partitions, alpha, seed=partition_seed,
+            min_size=min_size, verbose=verbose,
         )
     else:
         parts = uniform_partition(len(y_train), num_partitions, rng)

@@ -22,6 +22,7 @@ def dirichlet_partition(
     seed: int = 2020,
     min_size: int = 10,
     verbose: bool = False,
+    max_retries: int = 10000,
 ):
     """Partition sample indices across clients with per-class Dirichlet skew.
 
@@ -34,6 +35,12 @@ def dirichlet_partition(
     hard-codes ``seed=2020`` (``utils.py:320``); here it is a parameter
     defaulting to the same value.
 
+    ``min_size=0`` disables the retry (needed at scale: with few classes
+    and thousands of clients the min-size-10 constraint is unsatisfiable
+    and the reference's unbounded loop would spin forever — SURVEY.md
+    hard part 1). ``max_retries`` bounds the loop and raises instead of
+    hanging.
+
     Returns ``(parts, class_counts)``: a list of ``num_partitions`` int64
     index arrays (shuffled within each client, as in the reference) and a
     ``{client: {label: count}}`` dict.
@@ -43,10 +50,20 @@ def dirichlet_partition(
     classes = np.unique(labels)
     rng = np.random.RandomState(seed)
 
-    smallest = 0
+    smallest = -1
+    attempts = 0
     idx_batch: list[list[int]] = []
     while smallest < min_size:
+        attempts += 1
+        if attempts > max_retries:
+            raise RuntimeError(
+                f"dirichlet_partition: could not satisfy min_size={min_size} "
+                f"for {num_partitions} clients over {len(classes)} classes "
+                f"after {max_retries} tries; lower min_size (0 disables) or "
+                f"num_partitions"
+            )
         idx_batch = [[] for _ in range(num_partitions)]
+        smallest = 0
         for k in classes:
             idx_k = np.where(labels == k)[0]
             rng.shuffle(idx_k)

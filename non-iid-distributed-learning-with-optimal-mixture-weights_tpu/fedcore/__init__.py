@@ -4,7 +4,7 @@ from .aggregate import (
     make_p_solver,
     weighted_average,
 )
-from .client import make_client_round, make_local_update
+from .client import make_bucketed_round, make_client_round, make_local_update
 from .evaluate import make_evaluator
 
 __all__ = [
@@ -12,6 +12,7 @@ __all__ = [
     "fednova_effective_weights",
     "make_p_solver",
     "weighted_average",
+    "make_bucketed_round",
     "make_client_round",
     "make_local_update",
     "make_evaluator",

@@ -97,6 +97,54 @@ def make_local_update(
     return local_update
 
 
+def make_bucketed_round(
+    apply_fn: Callable,
+    task: str,
+    epochs: int,
+    batch_size: int,
+    n_maxes: tuple[int, ...],
+    bucket_counts: tuple[int, ...],
+    sequential: bool = False,
+):
+    """Client round over size-bucketed packs (``data.bucket_partitions``).
+
+    Each bucket has its own padded sample capacity, so the scanned batch
+    count tracks that bucket's largest client instead of the global
+    maximum — under heavy Dirichlet skew this removes most of the masked
+    no-op steps. Returns ``round_fn(params, X, y, idx_tuple, mask_tuple,
+    keys (J, ...), lr, mu, lam)`` whose outputs are concatenated in
+    bucket order (callers keep client-indexed arrays in that order).
+    """
+    if sequential and len(n_maxes) > 1:
+        raise ValueError("sequential compat mode requires a single bucket")
+    fns = [
+        make_client_round(apply_fn, task, epochs, batch_size, m, sequential)
+        for m in n_maxes
+    ]
+    offsets = [0]
+    for c in bucket_counts:
+        offsets.append(offsets[-1] + c)
+
+    def round_fn(params, X, y, idx_tuple, mask_tuple, keys, lr, mu, lam):
+        outs = [
+            fn(
+                params, X, y, idx_g, mask_g,
+                keys[offsets[g] : offsets[g + 1]], lr, mu, lam,
+            )
+            for g, (fn, idx_g, mask_g) in enumerate(
+                zip(fns, idx_tuple, mask_tuple)
+            )
+        ]
+        stacked = jax.tree.map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *[o[0] for o in outs]
+        )
+        losses = jnp.concatenate([o[1] for o in outs])
+        accs = jnp.concatenate([o[2] for o in outs])
+        return stacked, losses, accs
+
+    return round_fn
+
+
 def make_client_round(
     apply_fn: Callable,
     task: str,

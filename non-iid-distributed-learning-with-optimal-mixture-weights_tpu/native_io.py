@@ -1,0 +1,84 @@
+"""ctypes binding for the native C++ svmlight parser (``native/``).
+
+Builds the shared library on first use if a compiler is available (no
+pybind11 in this image; the C ABI + ctypes keeps the binding dependency-
+free). ``data/svmlight.py`` falls back to sklearn's parser when the
+native path is unavailable, so this is a pure accelerator.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+)
+_SRC = os.path.join(_NATIVE_DIR, "svmlight_parser.cpp")
+_LIB = os.path.join(_NATIVE_DIR, "libsvmlight_parser.so")
+
+_lib = None
+
+
+def _build() -> None:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB) or (
+        os.path.exists(_SRC)
+        and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+    ):
+        try:
+            _build()
+        except (OSError, subprocess.CalledProcessError) as e:
+            raise ImportError(f"cannot build native svmlight parser: {e}")
+    lib = ctypes.CDLL(_LIB)
+    lib.svmlight_parse.restype = ctypes.c_int
+    lib.svmlight_parse.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+        ctypes.POINTER(ctypes.c_long),
+        ctypes.POINTER(ctypes.c_long),
+    ]
+    lib.svmlight_free.restype = None
+    lib.svmlight_free.argtypes = [
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    _lib = lib
+    return lib
+
+
+def load_svmlight(path: str):
+    """Parse a LIBSVM file -> ``(X (n,d) float32 dense, y (n,) float64)``.
+
+    Raises ImportError if the native library cannot be built/loaded and
+    OSError on parse failure (callers fall back to sklearn).
+    """
+    lib = _load()
+    xp = ctypes.POINTER(ctypes.c_float)()
+    yp = ctypes.POINTER(ctypes.c_double)()
+    rows = ctypes.c_long()
+    cols = ctypes.c_long()
+    rc = lib.svmlight_parse(
+        path.encode(), ctypes.byref(xp), ctypes.byref(yp),
+        ctypes.byref(rows), ctypes.byref(cols),
+    )
+    if rc != 0:
+        raise OSError(f"native svmlight parse failed (rc={rc}): {path}")
+    n, d = rows.value, cols.value
+    try:
+        X = np.ctypeslib.as_array(xp, shape=(n, d)).copy()
+        y = np.ctypeslib.as_array(yp, shape=(n,)).copy()
+    finally:
+        lib.svmlight_free(xp, yp)
+    return X, y

@@ -64,6 +64,11 @@ def shard_setup(setup, mesh: Mesh):
     shard; use ``pack_partitions(..., pad_clients_to=...)`` (empty
     clients are inert and carry zero aggregation weight).
     """
+    if getattr(setup, "bucket_idx", None) is not None:
+        raise ValueError(
+            "mesh sharding over a bucketed setup is not supported yet; "
+            "use prepare_setup(buckets=1) with pad_clients_to"
+        )
     n_dev = mesh.devices.size
     j = setup.idx.shape[0]
     if j % n_dev != 0:

@@ -1,0 +1,9 @@
+from .reporting import Logger, check_significance, load_results, print_acc, print_time
+
+__all__ = [
+    "Logger",
+    "check_significance",
+    "load_results",
+    "print_acc",
+    "print_time",
+]

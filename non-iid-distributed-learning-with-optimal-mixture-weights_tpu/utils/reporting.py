@@ -1,0 +1,74 @@
+"""Post-hoc paper tooling: significance tests and LaTeX table emitters.
+
+Reference ``functions/utils.py:351-378`` (``check_significance``,
+``print_acc``, ``print_time``) and the trivial flushing ``Logger``
+(``utils.py:25-30``). These operate on the ``(algorithms, n_repeats)``
+accuracy/time matrices produced by the experiment driver.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+# Paired one-sided t threshold the reference hard-codes (~t_{0.05, df=10}).
+T_THRESHOLD = 1.812
+
+
+def check_significance(test_arr, best_arr, threshold: float = T_THRESHOLD) -> bool:
+    """True when ``best_arr`` significantly beats ``test_arr`` (paired
+    t-statistic above the threshold) — reference ``utils.py:351-353``."""
+    diff = np.asarray(best_arr, dtype=float) - np.asarray(test_arr, dtype=float)
+    denom = np.std(diff) / np.sqrt(len(diff))
+    if denom == 0:
+        # zero variance: a constant positive gap is inf/denominator in the
+        # reference (-> significant); identical rows are 0/0 (-> not)
+        return bool(np.mean(diff) > 0)
+    return float(np.mean(diff) / denom) > threshold
+
+
+def print_acc(matrix) -> str:
+    """LaTeX row: best row bold, rows NOT significantly worse underlined
+    (reference ``utils.py:355-367``)."""
+    matrix = np.asarray(matrix, dtype=float)
+    best_index = int(np.argmax(np.mean(matrix, axis=1)))
+    best_row = matrix[best_index]
+    out = []
+    for i, row in enumerate(matrix):
+        cell = f"{row.mean():.2f}$\\pm${row.std():.2f}"
+        if i == best_index:
+            out.append("&\\textbf{" + cell + "} ")
+        elif check_significance(row, best_row):
+            out.append("&" + cell + " ")
+        else:
+            out.append("&\\underline{" + cell + "} ")
+    return "".join(out)
+
+
+def print_time(matrix) -> str:
+    """LaTeX row of mean times, fastest bold (reference ``utils.py:369-378``)."""
+    matrix = np.asarray(matrix, dtype=float)
+    best_index = int(np.argmin(np.mean(matrix, axis=1)))
+    out = []
+    for i, row in enumerate(matrix):
+        cell = f"{row.mean():.2f}"
+        out.append("&\\textbf{" + cell + "} " if i == best_index else "&" + cell + " ")
+    return "".join(out)
+
+
+def load_results(path: str) -> dict:
+    """Load an ``exp1_{dataset}.pkl`` result dict (driver schema)."""
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+class Logger:
+    """Line-buffered file logger (reference ``utils.py:25-30``)."""
+
+    def __init__(self, filename: str):
+        self.log = open(filename, "w")
+
+    def write(self, content: str) -> None:
+        self.log.write(content)
+        self.log.flush()

@@ -94,6 +94,26 @@ class TestOneShot:
         assert res["test_acc"][-1] >= res["test_acc"][0] - 10.0
 
 
+def test_bucketed_matches_unbucketed():
+    # heavy skew: bucketing must change performance, not results
+    ds = load_dataset("digits", num_partitions=8, alpha=0.1)
+    kw = dict(kernel_type="linear", seed=100)
+    plain = prepare_setup(ds, rng=np.random.RandomState(100), **kw)
+    bucketed = prepare_setup(ds, rng=np.random.RandomState(100), buckets=3, **kw)
+    assert len(bucketed.n_maxes) == 3
+    # padded volume shrinks
+    assert sum(c * m for c, m in zip(bucketed.bucket_counts, bucketed.n_maxes)) \
+        < plain.num_clients * plain.n_maxes[0]
+    run = dict(lr=0.5, epoch=2, round=5, seed=0, lr_mode="constant")
+    a = FedAvg(plain, **run)
+    b = FedAvg(bucketed, **run)
+    # same dataset, same algorithm; differs only through shuffle RNG
+    assert abs(a["test_acc"][-1] - b["test_acc"][-1]) < 4.0
+    amw = FedAMW(bucketed, lr=0.5, epoch=2, round=4, lambda_reg_if=True,
+                 lambda_reg=5e-5, lr_p=0.001, seed=0, lr_mode="constant")
+    assert amw["test_acc"][-1] > 70.0
+
+
 def test_rff_path_end_to_end():
     ds = load_dataset("digits", num_partitions=4, alpha=0.5)
     setup = prepare_setup(ds, D=256, kernel_par=1.0, seed=100,

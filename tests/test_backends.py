@@ -68,6 +68,19 @@ class TestTorchBackend:
         assert osr["test_acc"].shape == (3,)
         assert osr["test_acc"][-1] > 70.0
 
+    def test_empty_client_inert(self, ds):
+        import torch
+
+        setup = get_backend("torch").prepare_setup(
+            ds, kernel_type="linear", seed=1, rng=np.random.RandomState(1)
+        )
+        setup.parts.append(torch.zeros(0, dtype=torch.long))
+        setup.sizes = np.append(setup.sizes, 0)
+        res = get_algorithm("FedNova", "torch")(
+            setup, lr=0.5, epoch=1, round=2, seed=0, lr_mode="constant"
+        )
+        assert np.all(np.isfinite(res["test_acc"]))
+
     def test_sequential_differs(self, torch_setup):
         par = get_algorithm("FedAvg", "torch")(
             torch_setup, lr=0.5, epoch=1, round=2, seed=0, lr_mode="constant")

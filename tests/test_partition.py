@@ -81,6 +81,21 @@ def test_uniform_partition_covers():
     assert max(len(p) for p in parts) - min(len(p) for p in parts) <= 1
 
 
+def test_min_size_zero_single_pass():
+    # scale configs: 2 classes x many clients can never satisfy min 10;
+    # min_size=0 must run exactly one assignment pass and return
+    y = np.random.RandomState(0).randint(0, 2, 5000)
+    parts, _ = dirichlet_partition(y, 128, 0.1, seed=2020, min_size=0)
+    assert len(parts) == 128
+    assert sum(len(p) for p in parts) == 5000
+
+
+def test_bounded_retries_raise():
+    y = np.random.RandomState(0).randint(0, 2, 5000)
+    with pytest.raises(RuntimeError, match="min_size"):
+        dirichlet_partition(y, 128, 0.1, seed=2020, min_size=10, max_retries=3)
+
+
 def test_skew_increases_as_alpha_shrinks(labels):
     def skew(alpha):
         parts, _ = dirichlet_partition(labels, 8, alpha, seed=2020)

@@ -1,0 +1,38 @@
+import numpy as np
+
+from fedamw_tpu.utils import Logger, check_significance, print_acc, print_time
+
+
+def test_check_significance():
+    best = np.array([90.0, 91.0, 92.0, 90.5, 91.5])
+    clearly_worse = best - 5.0 + np.random.RandomState(0).randn(5) * 0.1
+    assert check_significance(clearly_worse, best)
+    assert not check_significance(best, best)  # zero diff -> not significant
+    # constant positive gap (zero variance): reference computes inf -> True
+    assert check_significance(best - 5.0, best)
+
+
+def test_print_acc_marks_best_bold():
+    m = np.array([[90.0, 91.0], [80.0, 81.0]])
+    row = print_acc(m)
+    assert "\\textbf{90.50$\\pm$0.50}" in row
+    assert row.count("&") == 2
+
+
+def test_print_acc_underlines_insignificant():
+    m = np.array([[90.0, 91.0], [89.9, 91.2]])
+    row = print_acc(m)
+    assert "\\underline{" in row
+
+
+def test_print_time_marks_fastest():
+    m = np.array([[10.0, 12.0], [5.0, 6.0]])
+    row = print_time(m)
+    assert "\\textbf{5.50}" in row
+
+
+def test_logger(tmp_path):
+    path = tmp_path / "log.txt"
+    lg = Logger(str(path))
+    lg.write("hello\n")
+    assert path.read_text() == "hello\n"
