@@ -43,14 +43,24 @@ from ..ops.schedule import lr_schedule_array
 from .common import FedSetup, result_tuple
 
 
-def _keys(seed: int, *shape):
+# The two seed derivations below are the single source of truth for how
+# a driver seed becomes round keys and initial parameters. They work
+# both eagerly (one-shot algorithms) and traced inside the jitted
+# round trainers — the derivation must stay identical so seed-matched
+# cross-algorithm comparisons start from the same state.
+
+def _keys(seed, *shape):
     return jax.random.split(jax.random.PRNGKey(seed), shape)
 
 
-def _init_params(setup: FedSetup, seed: int):
-    return setup.model.init(
-        jax.random.fold_in(jax.random.PRNGKey(seed), 7), setup.D, setup.num_classes
+def _derive_params(init_fn, seed, D: int, num_classes: int):
+    return init_fn(
+        jax.random.fold_in(jax.random.PRNGKey(seed), 7), D, num_classes
     )
+
+
+def _init_params(setup: FedSetup, seed: int):
+    return _derive_params(setup.model.init, seed, setup.D, setup.num_classes)
 
 
 # All kernel factories below are memoized on their static configuration.
@@ -100,23 +110,42 @@ def _cached_oneshot_p_phase(apply_fn, task, n_val, val_batch_size, lr_p):
 
 
 @functools.lru_cache(maxsize=64)
-def _cached_round_trainer(apply_fn, task, epoch, batch_size, n_maxes, counts,
-                          rounds, aggregation, lr_p, val_batch_size, n_val,
+def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
+                          epoch, batch_size, n_maxes, counts, rounds,
+                          aggregation, lr_p, val_batch_size, n_val,
                           sequential):
     """The full jitted training run for the round-based algorithms: one
     lax.scan over rounds. Memoized so repeated runs (sweeps, benchmarks,
-    NNI trials) reuse the compiled program."""
+    NNI trials) reuse the compiled program.
+
+    The whole algorithm — PRNG key fan-out, parameter init, FedNova
+    weights, the round scan, metric stacking — lives INSIDE the one
+    jitted function, so an algorithm call is a single host->device
+    dispatch (plus the tiny host-side lr-schedule array shipped with it).
+    This matters enormously on remote-attached TPUs where every eager op
+    pays a network round-trip (measured: ~100 ms per eager
+    ``jax.random.split`` vs ~10 ms/round for the compiled scan itself).
+    """
     round_fn = make_bucketed_round(apply_fn, task, epoch, batch_size,
                                    n_maxes, counts, sequential=sequential)
     evaluate = make_evaluator(apply_fn, task)
+
+    def prologue(seed):
+        keys = _keys(seed, rounds, num_clients)
+        params0 = _derive_params(init_fn, seed, D, num_classes)
+        return keys, params0
 
     if aggregation == "learned":
         solve, init_opt = make_p_solver(task, n_val, val_batch_size, lr_p,
                                         momentum=0.9)
 
         @jax.jit
-        def train(params, p, opt_state, X, y, idx, mask, X_val, y_val,
-                  X_test, y_test, lrs, keys, pkeys, mu, lam):
+        def train(seed, X, y, idx, mask, X_val, y_val,
+                  X_test, y_test, lrs, p0, mu, lam):
+            keys, params = prologue(seed)
+            pkeys = jax.random.split(jax.random.PRNGKey(seed + 1), rounds)
+            p, opt_state = p0, init_opt(p0)
+
             def body(carry, inp):
                 params, p, opt_state = carry
                 lr_t, keys_t, pkey_t = inp
@@ -135,13 +164,20 @@ def _cached_round_trainer(apply_fn, task, epoch, batch_size, n_maxes, counts,
             (params, p, opt_state), metrics = jax.lax.scan(
                 body, (params, p, opt_state), (lrs, keys, pkeys)
             )
-            return metrics
+            return jnp.stack(metrics)
 
-        return train, init_opt
+        return train
 
     @jax.jit
-    def train(params, X, y, idx, mask, X_test, y_test, lrs, keys,
-              p_fixed, agg_w, mu, lam):
+    def train(seed, X, y, idx, mask, X_test, y_test, lrs,
+              p_fixed, sizes, mu, lam):
+        keys, params = prologue(seed)
+        if aggregation == "nova":
+            agg_w = fednova_effective_weights(sizes, p_fixed, epoch,
+                                              batch_size)
+        else:
+            agg_w = p_fixed
+
         def body(params, inp):
             lr_t, keys_t = inp
             stacked, losses, _ = round_fn(
@@ -153,9 +189,9 @@ def _cached_round_trainer(apply_fn, task, epoch, batch_size, n_maxes, counts,
             return params, (train_loss_t, tl, ta)
 
         _, metrics = jax.lax.scan(body, params, (lrs, keys))
-        return metrics
+        return jnp.stack(metrics)
 
-    return train, None
+    return train
 
 
 def Centralized(
@@ -305,43 +341,43 @@ def _round_based(
     Every array is an explicit jit argument — a closure-captured device
     array would be baked into the HLO as a literal constant (hundreds of
     MB for the feature matrix), bloating compile payloads. The jitted
-    trainer itself is memoized on the static config.
+    trainer itself is memoized on the static config, and one algorithm
+    call is ONE dispatch + ONE (3, rounds) metric fetch (remote-TPU
+    round-trips dominate otherwise; see _cached_round_trainer).
     """
-    n_val = int(setup.X_val.shape[0])
-    lrs = jnp.asarray(lr_schedule_array(lr, rounds, lr_mode))
-    keys = _keys(seed, rounds, setup.num_clients)
-    params0 = _init_params(setup, seed)
-    p_fixed = setup.p_fixed
-    idx_tup, mask_tup = setup.round_arrays()
-    mu = jnp.float32(mu)
-    lam = jnp.float32(lam)
+    import numpy as np
 
-    train, init_opt = _cached_round_trainer(
-        setup.model.apply, setup.task, epoch, batch_size,
+    n_val = int(setup.X_val.shape[0])
+    idx_tup, mask_tup = setup.round_arrays()
+
+    train = _cached_round_trainer(
+        setup.model.init, setup.model.apply, setup.task, setup.D,
+        setup.num_classes, setup.num_clients, epoch, batch_size,
         setup.n_maxes, setup.bucket_counts, rounds,
         aggregation, lr_p, val_batch_size, n_val, sequential,
     )
 
+    # Host-computed schedule from the Python-float lr: bit-identical to
+    # the torch backend's lr_schedule_array path (an in-graph f32
+    # rescale of unit factors can differ by 1 ulp); transferred as part
+    # of the one dispatch, not as a separate eager op.
+    lrs = lr_schedule_array(lr, rounds, lr_mode)
+
     if aggregation == "learned":
-        pkeys = _keys(seed + 1, rounds)
         metrics = train(
-            params0, p_fixed, init_opt(p_fixed), setup.X, setup.y,
-            idx_tup, mask_tup, setup.X_val, setup.y_val,
-            setup.X_test, setup.y_test, lrs, keys, pkeys, mu, lam,
+            seed, setup.X, setup.y, idx_tup, mask_tup,
+            setup.X_val, setup.y_val, setup.X_test, setup.y_test,
+            lrs, setup.p_fixed, float(mu), float(lam),
         )
     else:
-        if aggregation == "nova":
-            agg_w = fednova_effective_weights(
-                setup.sizes, p_fixed, epoch, batch_size
-            )
-        else:
-            agg_w = p_fixed
         metrics = train(
-            params0, setup.X, setup.y, idx_tup, mask_tup,
-            setup.X_test, setup.y_test, lrs, keys, p_fixed, agg_w, mu, lam,
+            seed, setup.X, setup.y, idx_tup, mask_tup,
+            setup.X_test, setup.y_test, lrs,
+            setup.p_fixed, setup.sizes, float(mu), float(lam),
         )
 
-    return result_tuple(*metrics)
+    metrics = np.asarray(metrics)
+    return result_tuple(metrics[0], metrics[1], metrics[2])
 
 
 def FedAvg(

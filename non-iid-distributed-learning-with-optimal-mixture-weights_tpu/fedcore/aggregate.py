@@ -105,25 +105,53 @@ def make_p_solver(
     grad_fn = jax.value_and_grad(batch_loss, has_aux=True)
 
     def solve(logits, y_val, p, opt_state, key, num_epochs: int):
+        # Epoch-wide gather vs per-step 16-row gather: same policy (and
+        # limit) as the client kernel — per-step row gathers are
+        # latency-bound on TPU, but the (n_batches, B, J, C) buffer
+        # grows with J*C and can reach GBs at the scale configs
+        # (n_val ~1e5, J ~1e3), so big setups keep the per-step form.
+        from .client import EPOCH_GATHER_BYTES_LIMIT
+
+        n_batches = -(-n_val // batch_size)
+        buf_bytes = (
+            n_batches * batch_size * logits.shape[1] * logits.shape[2]
+            * logits.dtype.itemsize
+        )
+        epoch_gather = buf_bytes <= EPOCH_GATHER_BYTES_LIMIT
+
         def epoch_body(carry, key_e):
             p, opt_state = carry
             b_idx, b_valid = epoch_batches(key_e, n_val, batch_size)
 
-            def step(carry, inp):
+            def p_step(carry, lb, yb, bv):
                 p, opt_state = carry
-                rows, bv = inp
-                (loss, out), g = grad_fn(p, logits[rows], y_val[rows], bv)
+                (loss, out), g = grad_fn(p, lb, yb, bv)
                 updates, opt_state = tx.update(g, opt_state, p)
                 p = optax.apply_updates(p, updates)
                 cnt = jnp.sum(bv)
                 if task == "classification":
-                    correct = jnp.sum(top1_correct(out, y_val[rows]) * bv)
+                    correct = jnp.sum(top1_correct(out, yb) * bv)
                 else:
                     correct = jnp.float32(0.0)
                 return (p, opt_state), (loss * cnt, correct, cnt)
 
+            if epoch_gather:
+                xs = (logits[b_idx], y_val[b_idx], b_valid)
+
+                def step(carry, inp):
+                    lb, yb, bv = inp
+                    return p_step(carry, lb, yb, bv)
+
+            else:
+                xs = (b_idx, b_valid)
+
+                def step(carry, inp):
+                    rows, bv = inp
+                    return p_step(carry, logits[rows], y_val[rows], bv)
+
             (p, opt_state), (losses, corrects, cnts) = jax.lax.scan(
-                step, (p, opt_state), (b_idx, b_valid)
+                step, (p, opt_state), xs,
+                unroll=min(16, b_idx.shape[0]),
             )
             return (p, opt_state), weighted_epoch_metrics(losses, corrects, cnts)
 

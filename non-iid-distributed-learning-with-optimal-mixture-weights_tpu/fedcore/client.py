@@ -35,7 +35,27 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
-from .batching import epoch_batches, weighted_epoch_metrics
+from .batching import batch_counts, epoch_batches, weighted_epoch_metrics
+
+
+# An epoch-gather buffer larger than this falls back to per-step row
+# gathers (see epoch_gather_bytes).
+EPOCH_GATHER_BYTES_LIMIT = int(1.5e9)
+
+# Scan-step unrolling: the per-step compute here is microscopic (a
+# (B, D) x (D, C) GEMM and its grads), so TPU loop-iteration overhead
+# dominates; unrolling lets XLA fuse several steps per loop trip.
+SGD_SCAN_UNROLL = 8
+
+
+def epoch_gather_bytes(
+    J: int, n_max: int, batch_size: int, D: int, itemsize: int
+) -> int:
+    """Size of the per-epoch feature buffer ``(J, n_batches, B, D)`` the
+    epoch-gather mode materializes — the single policy both gather-mode
+    deciders consult against ``EPOCH_GATHER_BYTES_LIMIT``."""
+    num_batches, _ = batch_counts(n_max, batch_size)
+    return J * num_batches * batch_size * D * itemsize
 
 
 def make_local_update(
@@ -44,6 +64,7 @@ def make_local_update(
     epochs: int,
     batch_size: int,
     n_max: int,
+    gather_mode: str = "auto",
 ):
     """Build the single-client local-SGD kernel.
 
@@ -52,6 +73,20 @@ def make_local_update(
     full shared arrays, ``idx/mask`` the client's padded row indices and
     validity mask of shape ``(n_max,)``, and ``lr/mu/lam`` dynamic
     scalars (no retrace across rounds).
+
+    ``gather_mode`` picks how minibatches reach the MXU:
+
+    - ``"epoch"``: ONE big HBM gather per epoch materializes the shuffled
+      batches as a contiguous ``(n_batches, B, D)`` buffer, and the SGD
+      scan consumes contiguous slices of it. Row gathers of 32 rows per
+      scan step are latency-bound on TPU (~77us/step measured); one
+      epoch-wide gather amortizes that to bandwidth cost.
+    - ``"step"``: the original per-step gather — minimal memory, for
+      setups where the epoch buffer would not fit.
+    - ``"auto"``: pick by ``epoch_gather_bytes`` for a SINGLE client —
+      vmap hides the client axis from this function, so vmapping callers
+      must decide themselves and pass an explicit mode
+      (``make_client_round`` does exactly that, with J included).
     """
     def batch_objective(params, anchor, xb, yb, bv, mu, lam):
         from ..ops.losses import training_loss
@@ -67,27 +102,49 @@ def make_local_update(
 
         anchor = params  # deep-copy of the incoming model (tools.py:180)
 
+        def sgd_step(p, xb, yb, bv):
+            (loss, (preds, cnt)), grads = grad_fn(
+                p, anchor, xb, yb, bv, mu, lam
+            )
+            ok = (cnt > 0).astype(jnp.float32)
+            p = jax.tree.map(lambda w, g: w - lr * ok * g, p, grads)
+            if task == "classification":
+                correct = jnp.sum(top1_correct(preds, yb) * bv)
+            else:
+                correct = jnp.float32(0.0)
+            return p, (loss * cnt, correct, cnt)
+
+        num_batches, _ = batch_counts(n_max, batch_size)
+        use_epoch_gather = gather_mode == "epoch" or (
+            gather_mode == "auto"
+            and epoch_gather_bytes(
+                1, n_max, batch_size, X.shape[-1], X.dtype.itemsize
+            )
+            <= EPOCH_GATHER_BYTES_LIMIT
+        )
+
         def epoch_body(p, key_e):
             # Fresh shuffle: valid rows first in random order, padding last.
             b_pos, b_valid = epoch_batches(key_e, n_max, batch_size, mask)
+            rows = idx[b_pos]  # (n_batches, B)
 
-            def step(p, inp):
-                pos, bv = inp
-                rows = idx[pos]
-                xb = X[rows]
-                yb = y[rows]
-                (loss, (preds, cnt)), grads = grad_fn(
-                    p, anchor, xb, yb, bv, mu, lam
-                )
-                ok = (cnt > 0).astype(jnp.float32)
-                p = jax.tree.map(lambda w, g: w - lr * ok * g, p, grads)
-                if task == "classification":
-                    correct = jnp.sum(top1_correct(preds, yb) * bv)
-                else:
-                    correct = jnp.float32(0.0)
-                return p, (loss * cnt, correct, cnt)
+            if use_epoch_gather:
+                xs = (X[rows], y[rows], b_valid)
 
-            p, (losses, corrects, cnts) = jax.lax.scan(step, p, (b_pos, b_valid))
+                def step(p, inp):
+                    xb, yb, bv = inp
+                    return sgd_step(p, xb, yb, bv)
+
+            else:
+                xs = (rows, b_valid)
+
+                def step(p, inp):
+                    rows_b, bv = inp
+                    return sgd_step(p, X[rows_b], y[rows_b], bv)
+
+            p, (losses, corrects, cnts) = jax.lax.scan(
+                step, p, xs, unroll=min(SGD_SCAN_UNROLL, num_batches)
+            )
             return p, weighted_epoch_metrics(losses, corrects, cnts)
 
         keys = jax.random.split(key, epochs)
@@ -162,21 +219,36 @@ def make_client_round(
     ``parallel``: ``jax.vmap`` with the global params broadcast — every
     client starts from the same state. ``sequential``: ``lax.scan``
     carrying params client-to-client (reference contamination artifact).
+
+    The epoch-gather buffer grows with the client axis (``(J, n_batches,
+    B, D)`` under vmap), so the epoch/step gather decision is made here
+    at trace time, where J and D are static shapes.
     """
-    local_update = make_local_update(apply_fn, task, epochs, batch_size, n_max)
+    kernels = {
+        m: make_local_update(apply_fn, task, epochs, batch_size, n_max, m)
+        for m in ("epoch", "step")
+    }
+
+    def pick(J: int, D: int, itemsize: int):
+        buf = epoch_gather_bytes(J, n_max, batch_size, D, itemsize)
+        mode = "epoch" if buf <= EPOCH_GATHER_BYTES_LIMIT else "step"
+        return kernels[mode]
 
     if not sequential:
-        vmapped = jax.vmap(
-            local_update,
-            in_axes=(None, None, None, 0, 0, 0, None, None, None),
-        )
 
         def round_fn(params, X, y, idx, mask, keys, lr, mu, lam):
+            local_update = pick(idx.shape[0], X.shape[-1], X.dtype.itemsize)
+            vmapped = jax.vmap(
+                local_update,
+                in_axes=(None, None, None, 0, 0, 0, None, None, None),
+            )
             return vmapped(params, X, y, idx, mask, keys, lr, mu, lam)
 
     else:
 
         def round_fn(params, X, y, idx, mask, keys, lr, mu, lam):
+            local_update = pick(1, X.shape[-1], X.dtype.itemsize)
+
             def body(p, inp):
                 idx_j, mask_j, key_j = inp
                 new_p, loss_j, acc_j = local_update(
