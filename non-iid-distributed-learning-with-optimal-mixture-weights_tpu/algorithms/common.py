@@ -45,6 +45,12 @@ class FedSetup:
     # size desc; all client-indexed arrays above use that same order.
     bucket_idx: tuple | None = None   # tuple of (J_g, n_max_g) arrays
     bucket_mask: tuple | None = None
+    # Number of mesh devices the client axis is sharded over (set by
+    # parallel.shard_setup). Kernels divide per-buffer memory estimates
+    # by this: a sharded epoch-gather buffer is distributed, so the
+    # per-device footprint — what the HBM limit is really about — is
+    # the global size over this factor.
+    mesh_devices: int = 1
 
     @property
     def num_clients(self) -> int:
@@ -93,6 +99,7 @@ def prepare_setup(
     pad_clients_to: int | None = None,
     n_max: int | None = None,
     buckets: int = 1,
+    client_multiple: int = 1,
 ) -> FedSetup:
     """Build the device-resident setup from a loaded dataset.
 
@@ -104,8 +111,12 @@ def prepare_setup(
 
     ``buckets > 1`` enables size-bucketed client packing (clients sorted
     by size descending; every client-indexed array uses that order) —
-    the padding-waste killer for heavy Dirichlet skew. Incompatible with
-    ``pad_clients_to``/mesh sharding for now.
+    the padding-waste killer for heavy Dirichlet skew.
+
+    ``client_multiple > 1`` pads every bucket's client axis (or the
+    single unbucketed axis) with inert empty clients to a multiple, so
+    the setup shards evenly over a mesh of that many devices — this is
+    how bucketing and mesh sharding compose (``parallel.shard_setup``).
     """
     if rng is None:
         rng = np.random.RandomState(seed)
@@ -130,17 +141,24 @@ def prepare_setup(
     bucket_idx = bucket_mask = None
     if buckets > 1:
         if pad_clients_to is not None:
-            raise ValueError("buckets>1 is incompatible with pad_clients_to")
-        packs, order = bucket_partitions(train_parts, buckets)
-        train_parts = [train_parts[i] for i in order]  # sorted-by-size order
+            raise ValueError(
+                "buckets>1 is incompatible with pad_clients_to; "
+                "use client_multiple for mesh-even bucket padding"
+            )
+        packs, _ = bucket_partitions(train_parts, buckets, client_multiple)
         bucket_idx = tuple(jnp.asarray(p.idx) for p in packs)
         bucket_mask = tuple(jnp.asarray(p.mask) for p in packs)
         # No globally-padded (J, N_max_global) pack: the bucketed view is
-        # the whole point — derive sizes/weights directly.
-        sizes = np.array([len(p) for p in train_parts], dtype=np.int32)
+        # the whole point — derive sizes/weights from the packs (in
+        # concatenated-bucket order, incl. inert padded slots).
+        sizes = np.concatenate([p.sizes for p in packs])
         weights = (sizes.astype(np.float64) / sizes.sum()).astype(np.float32)
         idx_full = mask_full = None
     else:
+        if client_multiple > 1:
+            j = (len(train_parts) if pad_clients_to is None
+                 else pad_clients_to)
+            pad_clients_to = -(-j // client_multiple) * client_multiple
         pack = pack_partitions(
             train_parts, n_max=n_max, pad_clients_to=pad_clients_to
         )

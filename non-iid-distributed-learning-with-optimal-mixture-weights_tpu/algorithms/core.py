@@ -76,9 +76,10 @@ _cached_local_update = functools.lru_cache(maxsize=128)(
 
 _cached_bucketed_round = functools.lru_cache(maxsize=128)(
     lambda apply_fn, task, epochs, batch_size, n_maxes, counts,
-    sequential=False: jax.jit(
+    sequential=False, shard_factor=1: jax.jit(
         make_bucketed_round(
-            apply_fn, task, epochs, batch_size, n_maxes, counts, sequential
+            apply_fn, task, epochs, batch_size, n_maxes, counts, sequential,
+            shard_factor,
         )
     )
 )
@@ -95,10 +96,12 @@ def _cached_oneshot_p_phase(apply_fn, task, n_val, val_batch_size, lr_p):
     evaluate = make_evaluator(apply_fn, task)
 
     @jax.jit
-    def p_phase(p, opt_state, logits, stacked, y_val, X_test, y_test, pkeys):
+    def p_phase(p, opt_state, logits, stacked, y_val, X_test, y_test, pkeys,
+                client_valid):
         def body(carry, key_t):
             p, opt_state = carry
-            p, opt_state, _, _ = solve(logits, y_val, p, opt_state, key_t, 1)
+            p, opt_state, _, _ = solve(logits, y_val, p, opt_state, key_t, 1,
+                                       client_valid=client_valid)
             g = weighted_average(stacked, p)
             tl, ta = evaluate(g, X_test, y_test)
             return (p, opt_state), (tl, ta)
@@ -113,7 +116,7 @@ def _cached_oneshot_p_phase(apply_fn, task, n_val, val_batch_size, lr_p):
 def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                           epoch, batch_size, n_maxes, counts, rounds,
                           aggregation, lr_p, val_batch_size, n_val,
-                          sequential):
+                          sequential, shard_factor):
     """The full jitted training run for the round-based algorithms: one
     lax.scan over rounds. Memoized so repeated runs (sweeps, benchmarks,
     NNI trials) reuse the compiled program.
@@ -127,7 +130,8 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
     ``jax.random.split`` vs ~10 ms/round for the compiled scan itself).
     """
     round_fn = make_bucketed_round(apply_fn, task, epoch, batch_size,
-                                   n_maxes, counts, sequential=sequential)
+                                   n_maxes, counts, sequential=sequential,
+                                   shard_factor=shard_factor)
     evaluate = make_evaluator(apply_fn, task)
 
     def prologue(seed):
@@ -141,10 +145,12 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
 
         @jax.jit
         def train(seed, X, y, idx, mask, X_val, y_val,
-                  X_test, y_test, lrs, p0, mu, lam):
+                  X_test, y_test, lrs, p0, sizes, mu, lam):
             keys, params = prologue(seed)
             pkeys = jax.random.split(jax.random.PRNGKey(seed + 1), rounds)
             p, opt_state = p0, init_opt(p0)
+            # inert padded clients (mesh-even packing) never earn weight
+            client_valid = (sizes > 0).astype(jnp.float32)
 
             def body(carry, inp):
                 params, p, opt_state = carry
@@ -155,7 +161,8 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 train_loss_t = jnp.sum(p * losses)  # current p (tools.py:434)
                 logits = client_logits(apply_fn, stacked, X_val)
                 p, opt_state, _, _ = solve(
-                    logits, y_val, p, opt_state, pkey_t, rounds
+                    logits, y_val, p, opt_state, pkey_t, rounds,
+                    client_valid=client_valid,
                 )
                 params = weighted_average(stacked, p)
                 tl, ta = evaluate(params, X_test, y_test)
@@ -231,6 +238,7 @@ def _one_shot_local_phase(setup, lr, epoch, batch_size, mu, lam, seed,
     round_fn = _cached_bucketed_round(
         setup.model.apply, setup.task, epoch, batch_size,
         setup.n_maxes, setup.bucket_counts, sequential,
+        setup.mesh_devices,
     )
     params = _init_params(setup, seed)
     keys = _keys(seed, setup.num_clients)
@@ -316,6 +324,7 @@ def FedAMW_OneShot(
     _, test_loss, test_acc = p_phase(
         p0, init_opt(p0), logits, stacked, setup.y_val,
         setup.X_test, setup.y_test, pkeys,
+        (setup.sizes > 0).astype(jnp.float32),
     )
     return result_tuple(train_loss, test_loss, test_acc)
 
@@ -355,6 +364,7 @@ def _round_based(
         setup.num_classes, setup.num_clients, epoch, batch_size,
         setup.n_maxes, setup.bucket_counts, rounds,
         aggregation, lr_p, val_batch_size, n_val, sequential,
+        setup.mesh_devices,
     )
 
     # Host-computed schedule from the Python-float lr: bit-identical to
@@ -367,7 +377,7 @@ def _round_based(
         metrics = train(
             seed, setup.X, setup.y, idx_tup, mask_tup,
             setup.X_val, setup.y_val, setup.X_test, setup.y_test,
-            lrs, setup.p_fixed, float(mu), float(lam),
+            lrs, setup.p_fixed, setup.sizes, float(mu), float(lam),
         )
     else:
         metrics = train(

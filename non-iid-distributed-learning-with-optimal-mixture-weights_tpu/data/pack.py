@@ -71,7 +71,9 @@ def pack_partitions(
 
 
 def bucket_partitions(
-    parts: list[np.ndarray], num_buckets: int
+    parts: list[np.ndarray],
+    num_buckets: int,
+    client_multiple: int = 1,
 ) -> tuple[list[ClientPack], np.ndarray]:
     """Group clients into size buckets to kill padding waste.
 
@@ -82,18 +84,31 @@ def bucket_partitions(
     stable) and packing contiguous groups separately gives each group
     its own ``N_max``, so compiled work tracks actual data volume.
 
+    ``client_multiple > 1`` pads every bucket's client axis with empty
+    clients up to a multiple of it, so each bucket shards evenly over a
+    ``client_multiple``-device mesh (the bucketing+sharding composition;
+    empty clients have all-zero masks, zero weight, and a masked-out
+    mixture gradient, so they are inert).
+
     Returns ``(packs, order)``: one ``ClientPack`` per bucket and the
-    client permutation applied (bucket outputs concatenated are in
-    ``order``'s client order). Bucket boundaries are chosen greedily on
-    the sorted sizes to minimize total padded volume ``sum_g J_g*max_g``
-    under equal-count splitting.
+    original index of every output slot in concatenated-bucket order,
+    with ``-1`` marking padded slots. Bucket boundaries are chosen on
+    the size-sorted order under equal-count splitting.
     """
     sizes = np.array([len(p) for p in parts])
     order = np.argsort(-sizes, kind="stable")
     num_buckets = max(1, min(num_buckets, len(parts)))
     groups = np.array_split(order, num_buckets)
-    packs = [pack_partitions([parts[i] for i in g]) for g in groups]
-    return packs, np.concatenate(groups)
+    packs, slots = [], []
+    for g in groups:
+        j_padded = -(-len(g) // client_multiple) * client_multiple
+        packs.append(
+            pack_partitions([parts[i] for i in g], pad_clients_to=j_padded)
+        )
+        slots.append(
+            np.concatenate([g, np.full(j_padded - len(g), -1, g.dtype)])
+        )
+    return packs, np.concatenate(slots)
 
 
 def split_train_val(

@@ -25,6 +25,11 @@ import jax
 import jax.numpy as jnp
 import optax
 
+# p-step unrolling: a p-step is far smaller than a client SGD step (a
+# (B, J, C) einsum and its (J,) gradient), so a deeper unroll than the
+# client kernel's SGD_SCAN_UNROLL pays off before program size hurts.
+P_SCAN_UNROLL = 16
+
 
 def weighted_average(stacked_params, p: jax.Array):
     """``sum_j p_j * theta_j`` over the leading client axis of every leaf.
@@ -84,6 +89,13 @@ def make_p_solver(
 
     ``num_epochs`` is static (it sets the scan length); FedAMW passes the
     communication-round count, the one-shot variant passes 1.
+
+    ``solve(..., client_valid=v)`` with a ``(J,)`` 0/1 mask freezes the
+    mixture weight of invalid clients: their gradient (and so their
+    momentum) is zeroed every step. Padded empty clients (mesh-even
+    packing) enter with p=0 and stay exactly 0 — without this, the
+    unconstrained p would drift onto padding and the padded run would
+    diverge from the reference's unpadded semantics.
     """
     from ..ops.losses import ce_per_example, masked_mean, mse_per_example
     from ..ops.metrics import top1_correct
@@ -104,7 +116,8 @@ def make_p_solver(
 
     grad_fn = jax.value_and_grad(batch_loss, has_aux=True)
 
-    def solve(logits, y_val, p, opt_state, key, num_epochs: int):
+    def solve(logits, y_val, p, opt_state, key, num_epochs: int,
+              client_valid=None):
         # Epoch-wide gather vs per-step 16-row gather: same policy (and
         # limit) as the client kernel — per-step row gathers are
         # latency-bound on TPU, but the (n_batches, B, J, C) buffer
@@ -126,6 +139,8 @@ def make_p_solver(
             def p_step(carry, lb, yb, bv):
                 p, opt_state = carry
                 (loss, out), g = grad_fn(p, lb, yb, bv)
+                if client_valid is not None:
+                    g = g * client_valid
                 updates, opt_state = tx.update(g, opt_state, p)
                 p = optax.apply_updates(p, updates)
                 cnt = jnp.sum(bv)
@@ -151,7 +166,7 @@ def make_p_solver(
 
             (p, opt_state), (losses, corrects, cnts) = jax.lax.scan(
                 step, (p, opt_state), xs,
-                unroll=min(16, b_idx.shape[0]),
+                unroll=min(P_SCAN_UNROLL, b_idx.shape[0]),
             )
             return (p, opt_state), weighted_epoch_metrics(losses, corrects, cnts)
 

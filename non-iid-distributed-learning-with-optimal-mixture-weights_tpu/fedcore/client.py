@@ -162,6 +162,7 @@ def make_bucketed_round(
     n_maxes: tuple[int, ...],
     bucket_counts: tuple[int, ...],
     sequential: bool = False,
+    shard_factor: int = 1,
 ):
     """Client round over size-bucketed packs (``data.bucket_partitions``).
 
@@ -175,7 +176,8 @@ def make_bucketed_round(
     if sequential and len(n_maxes) > 1:
         raise ValueError("sequential compat mode requires a single bucket")
     fns = [
-        make_client_round(apply_fn, task, epochs, batch_size, m, sequential)
+        make_client_round(apply_fn, task, epochs, batch_size, m, sequential,
+                          shard_factor)
         for m in n_maxes
     ]
     offsets = [0]
@@ -209,6 +211,7 @@ def make_client_round(
     batch_size: int,
     n_max: int,
     sequential: bool = False,
+    shard_factor: int = 1,
 ):
     """Lift the kernel over the client axis.
 
@@ -222,7 +225,10 @@ def make_client_round(
 
     The epoch-gather buffer grows with the client axis (``(J, n_batches,
     B, D)`` under vmap), so the epoch/step gather decision is made here
-    at trace time, where J and D are static shapes.
+    at trace time, where J and D are static shapes. ``shard_factor`` is
+    the mesh device count the client axis is sharded over: the buffer is
+    then distributed, so the per-device footprint — what the limit
+    protects — is the global size over this factor.
     """
     kernels = {
         m: make_local_update(apply_fn, task, epochs, batch_size, n_max, m)
@@ -231,7 +237,8 @@ def make_client_round(
 
     def pick(J: int, D: int, itemsize: int):
         buf = epoch_gather_bytes(J, n_max, batch_size, D, itemsize)
-        mode = "epoch" if buf <= EPOCH_GATHER_BYTES_LIMIT else "step"
+        per_device = buf // max(1, shard_factor)
+        mode = "epoch" if per_device <= EPOCH_GATHER_BYTES_LIMIT else "step"
         return kernels[mode]
 
     if not sequential:

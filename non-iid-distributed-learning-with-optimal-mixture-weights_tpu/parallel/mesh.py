@@ -60,29 +60,44 @@ def shard_setup(setup, mesh: Mesh):
     """Place a ``FedSetup`` on the mesh: client index sets sharded over
     the client axis, shared matrices replicated.
 
-    The number of clients must divide the mesh size evenly for an even
-    shard; use ``pack_partitions(..., pad_clients_to=...)`` (empty
-    clients are inert and carry zero aggregation weight).
+    Every client axis — the single packed one, or EACH size-bucket's —
+    must divide the mesh size evenly; build the setup with
+    ``prepare_setup(..., client_multiple=n_devices)`` (or
+    ``pad_clients_to``) so inert empty clients make up the difference.
     """
-    if getattr(setup, "bucket_idx", None) is not None:
-        raise ValueError(
-            "mesh sharding over a bucketed setup is not supported yet; "
-            "use prepare_setup(buckets=1) with pad_clients_to"
-        )
     n_dev = mesh.devices.size
-    j = setup.idx.shape[0]
-    if j % n_dev != 0:
-        raise ValueError(
-            f"{j} clients not divisible by {n_dev} devices; "
-            f"pad with pack_partitions(pad_clients_to=...)"
-        )
     cs2 = client_spec(mesh, 2)
     cs1 = client_spec(mesh, 1)
     rep = replicated(mesh)
+
+    def check(j, what):
+        if j % n_dev != 0:
+            raise ValueError(
+                f"{what} has {j} clients, not divisible by {n_dev} "
+                f"devices; build with prepare_setup(client_multiple="
+                f"{n_dev})"
+            )
+
+    if setup.bucket_idx is not None:
+        for g, b in enumerate(setup.bucket_idx):
+            check(b.shape[0], f"bucket {g}")
+        placed = dict(
+            bucket_idx=tuple(
+                jax.device_put(b, cs2) for b in setup.bucket_idx
+            ),
+            bucket_mask=tuple(
+                jax.device_put(m, cs2) for m in setup.bucket_mask
+            ),
+        )
+    else:
+        check(setup.idx.shape[0], "the client pack")
+        placed = dict(
+            idx=jax.device_put(setup.idx, cs2),
+            mask=jax.device_put(setup.mask, cs2),
+        )
     return dataclasses.replace(
         setup,
-        idx=jax.device_put(setup.idx, cs2),
-        mask=jax.device_put(setup.mask, cs2),
+        mesh_devices=n_dev,
         sizes=jax.device_put(setup.sizes, cs1),
         p_fixed=jax.device_put(setup.p_fixed, rep),
         X=jax.device_put(setup.X, rep),
@@ -91,6 +106,7 @@ def shard_setup(setup, mesh: Mesh):
         y_test=jax.device_put(setup.y_test, rep),
         X_val=jax.device_put(setup.X_val, rep),
         y_val=jax.device_put(setup.y_val, rep),
+        **placed,
     )
 
 

@@ -102,3 +102,86 @@ def test_padded_clients_for_mesh():
     sharded = shard_setup(setup, mesh)
     res = FedAvg(sharded, lr=0.5, epoch=1, round=3, seed=0, lr_mode="constant")
     assert res["test_acc"][-1] > 60.0
+
+
+# --- bucketing x mesh composition -----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def bucketed20():
+    """20 clients in 3 size buckets, each bucket padded to a multiple of
+    8 — the packing the 1024/4096-client scale configs rely on."""
+    ds = load_dataset("digits", num_partitions=20, alpha=0.3)
+    return prepare_setup(ds, kernel_type="linear", seed=100,
+                         rng=np.random.RandomState(100),
+                         buckets=3, client_multiple=8)
+
+
+def test_bucketed_setup_is_mesh_even(bucketed20):
+    assert bucketed20.bucket_idx is not None
+    for b in bucketed20.bucket_idx:
+        assert b.shape[0] % 8 == 0
+    # padded slots exist (20 clients never split 3-ways into 8-multiples)
+    assert bucketed20.num_clients > 20
+    assert int((np.asarray(bucketed20.sizes) > 0).sum()) == 20
+    # inert padding carries zero weight
+    p = np.asarray(bucketed20.p_fixed)
+    assert np.all(p[np.asarray(bucketed20.sizes) == 0] == 0)
+    np.testing.assert_allclose(p.sum(), 1.0, atol=1e-6)
+
+
+def test_bucketed_fedavg_sharded_matches_unsharded(bucketed20):
+    mesh = make_mesh()
+    sharded = shard_setup(bucketed20, mesh)
+    kw = dict(lr=0.5, epoch=1, round=4, seed=0, lr_mode="constant")
+    res_u = FedAvg(bucketed20, **kw)
+    res_s = FedAvg(sharded, **kw)
+    np.testing.assert_allclose(res_s["test_acc"], res_u["test_acc"],
+                               atol=1e-4)
+    np.testing.assert_allclose(res_s["train_loss"], res_u["train_loss"],
+                               atol=1e-5)
+
+
+def test_bucketed_fedamw_sharded_matches_unsharded(bucketed20):
+    from fedamw_tpu.algorithms import FedAMW
+
+    mesh = make_mesh()
+    sharded = shard_setup(bucketed20, mesh)
+    kw = dict(lr=0.5, epoch=1, round=3, lambda_reg=1e-4, lr_p=1e-3,
+              seed=0, lr_mode="constant")
+    res_u = FedAMW(bucketed20, **kw)
+    res_s = FedAMW(sharded, **kw)
+    np.testing.assert_allclose(res_s["test_acc"], res_u["test_acc"],
+                               atol=1e-4)
+
+
+def test_bucketed_fedamw_padding_is_inert(bucketed20):
+    """Learned mixture weights must stay exactly zero on padded clients
+    (otherwise padded and unpadded runs diverge semantically)."""
+    import jax.numpy as jnp
+
+    from fedamw_tpu.fedcore import make_p_solver
+
+    J = bucketed20.num_clients
+    n_val = int(bucketed20.X_val.shape[0])
+    solve, init_opt = make_p_solver(bucketed20.task, n_val, 16, 1e-2,
+                                    momentum=0.9)
+    valid = (np.asarray(bucketed20.sizes) > 0).astype(np.float32)
+    p0 = jnp.asarray(bucketed20.p_fixed)
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(
+        rng.randn(n_val, J, bucketed20.num_classes).astype(np.float32))
+    p, _, _, _ = solve(logits, bucketed20.y_val, p0, init_opt(p0),
+                       jax.random.PRNGKey(0), 2,
+                       client_valid=jnp.asarray(valid))
+    p = np.asarray(p)
+    assert np.all(p[valid == 0] == 0.0)
+    assert np.any(p[valid == 1] != np.asarray(p0)[valid == 1])
+
+
+def test_shard_setup_rejects_uneven_bucket():
+    ds = load_dataset("digits", num_partitions=10, alpha=0.5)
+    setup = prepare_setup(ds, kernel_type="linear", seed=1,
+                          rng=np.random.RandomState(1), buckets=3)
+    with pytest.raises(ValueError, match="divisible"):
+        shard_setup(setup, make_mesh())
