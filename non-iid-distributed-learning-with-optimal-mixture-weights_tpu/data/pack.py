@@ -59,7 +59,11 @@ def pack_partitions(
         sizes = np.concatenate([sizes, np.zeros(pad_clients_to - j, np.int32)])
         parts = list(parts) + [np.zeros(0, np.int64)] * (pad_clients_to - j)
         j = pad_clients_to
-    cap = int(sizes.max()) if n_max is None else int(n_max)
+    # cap >= 1: an all-empty pack (possible at extreme client counts
+    # with min_size=0, e.g. a bucket of only empty clients) still needs
+    # a nonzero sample axis for the fixed-shape kernel; the all-zero
+    # mask keeps it inert.
+    cap = max(1, int(sizes.max()) if n_max is None else int(n_max))
     if cap < int(sizes.max()):
         raise ValueError(f"n_max={cap} < largest client ({int(sizes.max())})")
     idx = np.zeros((j, cap), dtype=np.int32)

@@ -1,0 +1,74 @@
+"""Scale-config regression tests (CPU-sized stand-ins for scale_bench.py).
+
+The BASELINE.md scale configs (1024/4096 Dirichlet-alpha=0.1 clients,
+min_size=0) hit packing edge cases the reference never could — clients
+with zero training samples, whole buckets of empty clients — so these
+pin the behavior at the real client counts with small feature dims.
+"""
+
+import numpy as np
+import pytest
+
+from fedamw_tpu.algorithms import FedAMW, FedAvg, prepare_setup
+from fedamw_tpu.data import FederatedDataset, dirichlet_partition
+from fedamw_tpu.data.pack import pack_partitions
+from fedamw_tpu.data.synthetic import synthetic_classification
+
+
+def _dataset(n, d, classes, clients, seed=3):
+    X, y, Xt, yt = synthetic_classification(n, d, classes, seed=seed)
+    parts, _ = dirichlet_partition(y, clients, alpha=0.1, seed=2020,
+                                   min_size=0)
+    return FederatedDataset(
+        name="scale-synth", task_type="classification",
+        num_classes=classes, d=d, X_train=X, y_train=y, X_test=Xt,
+        y_test=yt, parts=parts, source="synthetic",
+    )
+
+
+@pytest.fixture(scope="module")
+def ds1024():
+    # 1024 clients over 8192 samples: alpha=0.1 + min_size=0 leaves many
+    # clients with zero training rows after the 80/20 val split.
+    return _dataset(8192, 20, 7, 1024)
+
+
+def test_1024_clients_partition_covers_all(ds1024):
+    all_idx = np.sort(np.concatenate(ds1024.parts))
+    np.testing.assert_array_equal(all_idx, np.arange(len(ds1024.y_train)))
+
+
+def test_1024_clients_bucketed_fedavg_runs(ds1024):
+    setup = prepare_setup(ds1024, kernel_type="linear", seed=100,
+                          rng=np.random.RandomState(100), model="mlp16",
+                          buckets=16)
+    assert setup.num_clients == 1024
+    res = FedAvg(setup, lr=0.2, epoch=1, batch_size=32, round=2, seed=0,
+                 lr_mode="constant")
+    assert np.all(np.isfinite(res["test_loss"]))
+    assert res["test_acc"][-1] > 100.0 / 7  # beats chance in 2 rounds
+
+
+def test_1024_clients_fedamw_runs(ds1024):
+    setup = prepare_setup(ds1024, kernel_type="linear", seed=100,
+                          rng=np.random.RandomState(100), buckets=16)
+    res = FedAMW(setup, lr=0.2, epoch=1, batch_size=32, round=2,
+                 lambda_reg=1e-4, lr_p=1e-3, seed=0, lr_mode="constant")
+    assert np.all(np.isfinite(res["test_loss"]))
+
+
+def test_all_empty_pack_is_inert():
+    # A bucket of only empty clients (seen at 4096 clients) packs to a
+    # 1-wide masked sample axis instead of a zero-size gather.
+    pack = pack_partitions([np.zeros(0, np.int64)] * 4)
+    assert pack.n_max == 1
+    assert pack.mask.sum() == 0.0
+
+
+def test_empty_clients_stay_empty_through_training(ds1024):
+    setup = prepare_setup(ds1024, kernel_type="linear", seed=100,
+                          rng=np.random.RandomState(100), buckets=16)
+    sizes = np.asarray(setup.sizes)
+    assert (sizes == 0).any()  # the regime this test exists for
+    p = np.asarray(setup.p_fixed)
+    assert np.all(p[sizes == 0] == 0)
