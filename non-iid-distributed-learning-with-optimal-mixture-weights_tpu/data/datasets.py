@@ -2,12 +2,14 @@
 
 Resolution order for a named dataset:
 
-1. LIBSVM files ``{data_dir}/{name}`` and ``{data_dir}/{name}.t``
+1. image files for ``mnist``/``CIFAR10`` (IDX / CIFAR-binary under
+   ``data_dir``, the formats torchvision caches — ``data/images.py``);
+2. LIBSVM files ``{data_dir}/{name}`` and ``{data_dir}/{name}.t``
    (train/test, as the reference expects);
-2. sklearn's bundled ``digits`` (no download needed);
-3. a deterministic synthetic stand-in matching the registry's
+3. sklearn's bundled ``digits`` (no download needed);
+4. a deterministic synthetic stand-in matching the registry's
    (num_examples, dimensional, num_classes) signature — this box has no
-   network egress, so MNIST/LIBSVM downloads are not an option.
+   network egress, so downloads are not an option.
 
 The returned ``FederatedDataset`` carries raw (pre-RFF) features; feature
 mapping happens once, downstream, on device (``ops/rff.py``).
@@ -20,6 +22,7 @@ import dataclasses
 import numpy as np
 
 from ..config import get_parameter
+from .images import IMAGE_LOADERS
 from .partition import dirichlet_partition, uniform_partition
 from .svmlight import is_regression, load_svmlight
 from .synthetic import generate_synthetic, synthetic_classification
@@ -91,17 +94,22 @@ def load_dataset(
 
     source = "file"
     try:
-        X_train, y_train = load_svmlight(name, data_dir)
-        X_test, y_test = load_svmlight(name + ".t", data_dir)
-        d = X_train.shape[1]
-        if X_test.shape[1] != d:  # LIBSVM files can disagree on max index
-            w = max(X_test.shape[1], d)
-            X_train = _pad_cols(X_train, w)
-            X_test = _pad_cols(X_test, w)
-            d = w
-        num_classes = (
-            1 if is_regression(name) else int(len(np.unique(y_train)))
-        )
+        if name in IMAGE_LOADERS:
+            X_train, y_train, X_test, y_test = IMAGE_LOADERS[name](data_dir)
+            d = X_train.shape[1]
+            num_classes = 10
+        else:
+            X_train, y_train = load_svmlight(name, data_dir)
+            X_test, y_test = load_svmlight(name + ".t", data_dir)
+            d = X_train.shape[1]
+            if X_test.shape[1] != d:  # LIBSVM files can disagree on max index
+                w = max(X_test.shape[1], d)
+                X_train = _pad_cols(X_train, w)
+                X_test = _pad_cols(X_test, w)
+                d = w
+            num_classes = (
+                1 if is_regression(name) else int(len(np.unique(y_train)))
+            )
     except FileNotFoundError:
         if name == "digits":
             X_train, y_train, X_test, y_test = _load_digits()

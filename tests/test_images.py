@@ -1,0 +1,135 @@
+"""IDX / CIFAR-binary readers and their data_tf parity.
+
+Fixtures are crafted in-memory files, not downloads (zero-egress box).
+Parity target: the reference's ``data_tf`` (``functions/utils.py:67-72``)
+applied through torchvision's PIL->numpy view — MNIST row-major 784,
+CIFAR10 HWC 3072, pixels mapped ``x/255`` then ``(x-0.5)/0.5``.
+"""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from fedamw_tpu.data import load_dataset
+from fedamw_tpu.data.images import (
+    data_tf,
+    load_cifar10,
+    load_mnist,
+    read_idx,
+)
+
+
+def write_idx(path, arr, compress=False):
+    codes = {np.uint8: 0x08, np.int32: 0x0C, np.float32: 0x0D}
+    code = codes[arr.dtype.type]
+    header = struct.pack(">HBB", 0, code, arr.ndim)
+    header += struct.pack(f">{arr.ndim}I", *arr.shape)
+    payload = arr.astype(arr.dtype.newbyteorder(">")).tobytes()
+    opener = gzip.open if compress else open
+    with opener(path, "wb") as f:
+        f.write(header + payload)
+
+
+@pytest.fixture
+def mnist_dir(tmp_path):
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (20, 28, 28)).astype(np.uint8)
+    labels = rng.randint(0, 10, 20).astype(np.uint8)
+    timgs = rng.randint(0, 256, (8, 28, 28)).astype(np.uint8)
+    tlabels = rng.randint(0, 10, 8).astype(np.uint8)
+    write_idx(str(tmp_path / "train-images-idx3-ubyte"), imgs)
+    write_idx(str(tmp_path / "train-labels-idx1-ubyte"), labels)
+    # test split gzipped: both forms must parse
+    write_idx(str(tmp_path / "t10k-images-idx3-ubyte.gz"), timgs,
+              compress=True)
+    write_idx(str(tmp_path / "t10k-labels-idx1-ubyte.gz"), tlabels,
+              compress=True)
+    return tmp_path, imgs, labels, timgs, tlabels
+
+
+@pytest.fixture
+def cifar_dir(tmp_path):
+    rng = np.random.RandomState(1)
+    d = tmp_path / "cifar-10-batches-bin"
+    d.mkdir()
+    all_chw, all_labels = [], []
+    for i in range(1, 6):
+        labels = rng.randint(0, 10, 4).astype(np.uint8)
+        chw = rng.randint(0, 256, (4, 3, 32, 32)).astype(np.uint8)
+        rec = np.concatenate(
+            [labels[:, None], chw.reshape(4, -1)], axis=1
+        ).astype(np.uint8)
+        rec.tofile(str(d / f"data_batch_{i}.bin"))
+        all_chw.append(chw)
+        all_labels.append(labels)
+    tlabels = rng.randint(0, 10, 4).astype(np.uint8)
+    tchw = rng.randint(0, 256, (4, 3, 32, 32)).astype(np.uint8)
+    np.concatenate([tlabels[:, None], tchw.reshape(4, -1)], axis=1).astype(
+        np.uint8
+    ).tofile(str(d / "test_batch.bin"))
+    return (tmp_path, np.concatenate(all_chw),
+            np.concatenate(all_labels), tchw, tlabels)
+
+
+def test_idx_roundtrip(tmp_path):
+    arr = np.arange(24, dtype=np.uint8).reshape(2, 3, 4)
+    write_idx(str(tmp_path / "x"), arr)
+    np.testing.assert_array_equal(read_idx(str(tmp_path / "x")), arr)
+
+
+def test_idx_rejects_garbage(tmp_path):
+    p = tmp_path / "bad"
+    p.write_bytes(b"not an idx file at all")
+    with pytest.raises(ValueError, match="IDX"):
+        read_idx(str(p))
+
+
+def test_data_tf_formula():
+    x = np.array([[0, 255, 127]], dtype=np.uint8)
+    out = data_tf(x)
+    # (x/255 - 0.5) / 0.5, reference utils.py:67-72
+    np.testing.assert_allclose(
+        out, [[-1.0, 1.0, (127 / 255 - 0.5) / 0.5]], atol=1e-6
+    )
+    assert out.dtype == np.float32
+
+
+def test_load_mnist_parity(mnist_dir):
+    path, imgs, labels, timgs, tlabels = mnist_dir
+    X, y, Xt, yt = load_mnist(str(path))
+    assert X.shape == (20, 784) and Xt.shape == (8, 784)
+    np.testing.assert_array_equal(y, labels.astype(np.int32))
+    np.testing.assert_array_equal(yt, tlabels.astype(np.int32))
+    # row-major flatten of the raw image, then the data_tf map
+    expect = (imgs.reshape(20, -1).astype(np.float32) / 255 - 0.5) / 0.5
+    np.testing.assert_allclose(X, expect, atol=1e-6)
+
+
+def test_load_cifar10_parity(cifar_dir):
+    path, chw, labels, tchw, tlabels = cifar_dir
+    X, y, Xt, yt = load_cifar10(str(path))
+    assert X.shape == (20, 3072) and Xt.shape == (4, 3072)
+    np.testing.assert_array_equal(y, labels.astype(np.int32))
+    # reference order: PIL->numpy is HWC, flattened
+    hwc = chw.transpose(0, 2, 3, 1).reshape(20, -1).astype(np.float32)
+    np.testing.assert_allclose(X, (hwc / 255 - 0.5) / 0.5, atol=1e-6)
+
+
+def test_load_dataset_resolves_mnist_files(mnist_dir):
+    path = mnist_dir[0]
+    ds = load_dataset("mnist", num_partitions=2, alpha=-1,
+                      data_dir=str(path), rng=np.random.RandomState(0))
+    assert ds.source == "file"
+    assert ds.d == 784 and ds.num_classes == 10
+    assert len(ds.parts) == 2
+
+
+def test_load_dataset_mnist_falls_back_without_files(tmp_path):
+    ds = load_dataset("mnist", num_partitions=2, alpha=-1,
+                      data_dir=str(tmp_path), rng=np.random.RandomState(0),
+                      min_size=0)
+    assert ds.source == "synthetic"
+    assert ds.d == 784  # registry signature preserved
