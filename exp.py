@@ -40,17 +40,63 @@ def parse_args():
                     choices=["reference", "paper", "constant"])
     ap.add_argument("--sequential", action="store_true",
                     help="reference client-contamination compat mode")
+    ap.add_argument("--verbose", action="store_true",
+                    help="stream per-round test loss/acc during the "
+                         "jitted round scans (reference tools.py:236)")
+    ap.add_argument("--profile", type=str, default=None, metavar="DIR",
+                    help="capture a jax.profiler trace of the run to DIR")
     return ap.parse_args()
 
 
 def main():
     args = parse_args()
     from fedamw_tpu.config import get_parameter
-    from fedamw_tpu.data import load_dataset
-    from fedamw_tpu.ops.rff import heterogeneity_from_parts
     from fedamw_tpu.registry import get_backend
 
     params = get_parameter(args.dataset)
+    backend = get_backend(args.backend)
+    R = args.round
+    names = ["CL", "DL", "FedAMW_OneShot", "FedAvg", "FedProx", "FedAMW"]
+    train_mat = np.empty((6, R, args.n_repeats))
+    error_mat = np.empty((6, R, args.n_repeats))
+    acc_mat = np.empty((6, R, args.n_repeats))
+    hete = np.empty(args.n_repeats)
+
+    if args.profile:  # opt-in jax.profiler trace of the whole run
+        import jax
+
+        jax.profiler.start_trace(args.profile)
+    try:
+        _run_repeats(args, params, backend, train_mat, error_mat, acc_mat,
+                     hete)
+    finally:
+        # flush the trace even when a repeat raises - a profile of the
+        # failing run is the one you want most
+        if args.profile:
+            import jax
+
+            jax.profiler.stop_trace()
+            print(f"profiler trace -> {args.profile}")
+
+    data_ = {
+        "epochs": R,
+        "train_loss": train_mat,
+        "test_loss": error_mat,
+        "test_acc": acc_mat,
+        "heterogeneity": hete,
+        "name": names,
+    }
+    os.makedirs(args.result_dir, exist_ok=True)
+    out = os.path.join(args.result_dir, f"exp1_{args.dataset}.pkl")
+    with open(out, "wb") as f:
+        pickle.dump(data_, f)
+    print(f"results -> {out}")
+
+
+def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete):
+    from fedamw_tpu.data import load_dataset
+    from fedamw_tpu.ops.rff import heterogeneity_from_parts
+
     kernel_type = params["kernel_type"]
     k_par = params["kernel_par"]
     lr = params["lr"]
@@ -59,14 +105,7 @@ def main():
     mu = params["lambda_prox"]
     lam = params["lambda_reg"]
     lam_os = params.get("lambda_reg_os", lam)
-
-    backend = get_backend(args.backend)
     R = args.round
-    names = ["CL", "DL", "FedAMW_OneShot", "FedAvg", "FedProx", "FedAMW"]
-    train_mat = np.empty((6, R, args.n_repeats))
-    error_mat = np.empty((6, R, args.n_repeats))
-    acc_mat = np.empty((6, R, args.n_repeats))
-    hete = np.empty(args.n_repeats)
 
     for t in range(args.n_repeats):
         rng = np.random.RandomState(args.seed + t)
@@ -105,7 +144,8 @@ def main():
         print(f"FedAMW_OneShot: final acc {osr['test_acc'][-1]:.2f}")
 
         round_common = dict(epoch=args.local_epoch, round=R,
-                            lr_mode=args.lr_mode, **common)
+                            lr_mode=args.lr_mode, verbose=args.verbose,
+                            **common)
         avg = algos["FedAvg"](setup, lr=lr, **round_common)
         prox = algos["FedProx"](setup, lr=lr, prox=True, mu=mu, **round_common)
         amw = algos["FedAMW"](setup, lr=lr, lambda_reg_if=True,
@@ -118,20 +158,6 @@ def main():
             print(f"{name}: final acc {res['test_acc'][-1]:.2f}")
         print(f"[repeat {t}] wall time {time.time() - t0:.1f}s "
               f"(backend={args.backend})")
-
-    data_ = {
-        "epochs": R,
-        "train_loss": train_mat,
-        "test_loss": error_mat,
-        "test_acc": acc_mat,
-        "heterogeneity": hete,
-        "name": names,
-    }
-    os.makedirs(args.result_dir, exist_ok=True)
-    out = os.path.join(args.result_dir, f"exp1_{args.dataset}.pkl")
-    with open(out, "wb") as f:
-        pickle.dump(data_, f)
-    print(f"results -> {out}")
 
 
 if __name__ == "__main__":

@@ -1,4 +1,4 @@
-from .common import FedSetup, HParams, prepare_setup, result_tuple
+from .common import FedSetup, prepare_setup, result_tuple
 from .core import (
     Centralized,
     Distributed,
@@ -23,7 +23,6 @@ ALGORITHMS = {
 
 __all__ = [
     "FedSetup",
-    "HParams",
     "prepare_setup",
     "result_tuple",
     "ALGORITHMS",

@@ -188,27 +188,6 @@ def prepare_setup(
     )
 
 
-@dataclasses.dataclass(frozen=True)
-class HParams:
-    """Per-algorithm hyperparameters (reference keyword surface)."""
-
-    lr: float = 0.01
-    epochs: int = 2              # local epochs per round
-    batch_size: int = 32
-    rounds: int = 100            # communication rounds
-    mu: float = 0.0              # FedProx coefficient (0 = off)
-    lam: float = 0.0             # ridge coefficient (0 = off)
-    lr_p: float = 5e-5           # mixture-weight lr
-    p_momentum: float = 0.9
-    val_batch_size: int = 16
-    lr_mode: str = "reference"   # see ops/schedule.py
-    sequential: bool = False     # reference client-contamination compat
-    seed: int = 0
-
-    def replace(self, **kw) -> "HParams":
-        return dataclasses.replace(self, **kw)
-
-
 def result_tuple(train_loss, test_loss, test_acc) -> dict[str, Any]:
     """Uniform result record: numpy copies of the metric vectors."""
     return {

@@ -63,6 +63,17 @@ def _init_params(setup: FedSetup, seed: int):
     return _derive_params(setup.model.init, seed, setup.D, setup.num_classes)
 
 
+def _print_round(t, train_loss, test_loss, test_acc):
+    """Host-side sink for the per-round metric stream (the reference
+    prints test loss/acc after every round's eval, tools.py:236)."""
+    print(
+        f"[round {int(t):3d}] train loss {float(train_loss):8.5f} | "
+        f"test loss {float(test_loss):8.5f} | "
+        f"test acc {float(test_acc):5.1f}%",
+        flush=True,
+    )
+
+
 # All kernel factories below are memoized on their static configuration.
 # jit caches by function identity — rebuilding a closure per algorithm
 # call would recompile the whole round scan every time (and the first
@@ -116,7 +127,7 @@ def _cached_oneshot_p_phase(apply_fn, task, n_val, val_batch_size, lr_p):
 def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                           epoch, batch_size, n_maxes, counts, rounds,
                           aggregation, lr_p, val_batch_size, n_val,
-                          sequential, shard_factor):
+                          sequential, shard_factor, verbose=False):
     """The full jitted training run for the round-based algorithms: one
     lax.scan over rounds. Memoized so repeated runs (sweeps, benchmarks,
     NNI trials) reuse the compiled program.
@@ -139,6 +150,15 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
         params0 = _derive_params(init_fn, seed, D, num_classes)
         return keys, params0
 
+    def stream_metrics(t, train_loss_t, tl, ta):
+        # Per-round observability matching the reference's per-eval print
+        # (tools.py:236), emitted from INSIDE the fused round scan. The
+        # callback is unordered (cheap, non-blocking); the round index in
+        # the message makes ordering unambiguous.
+        if verbose:
+            jax.debug.callback(_print_round, t, train_loss_t, tl, ta,
+                               ordered=False)
+
     if aggregation == "learned":
         solve, init_opt = make_p_solver(task, n_val, val_batch_size, lr_p,
                                         momentum=0.9)
@@ -154,7 +174,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
 
             def body(carry, inp):
                 params, p, opt_state = carry
-                lr_t, keys_t, pkey_t = inp
+                t, lr_t, keys_t, pkey_t = inp
                 stacked, losses, _ = round_fn(
                     params, X, y, idx, mask, keys_t, lr_t, mu, lam,
                 )
@@ -166,10 +186,12 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 )
                 params = weighted_average(stacked, p)
                 tl, ta = evaluate(params, X_test, y_test)
+                stream_metrics(t, train_loss_t, tl, ta)
                 return (params, p, opt_state), (train_loss_t, tl, ta)
 
             (params, p, opt_state), metrics = jax.lax.scan(
-                body, (params, p, opt_state), (lrs, keys, pkeys)
+                body, (params, p, opt_state),
+                (jnp.arange(rounds), lrs, keys, pkeys),
             )
             return jnp.stack(metrics)
 
@@ -186,16 +208,18 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
             agg_w = p_fixed
 
         def body(params, inp):
-            lr_t, keys_t = inp
+            t, lr_t, keys_t = inp
             stacked, losses, _ = round_fn(
                 params, X, y, idx, mask, keys_t, lr_t, mu, lam,
             )
             train_loss_t = jnp.sum(p_fixed * losses)
             params = weighted_average(stacked, agg_w)
             tl, ta = evaluate(params, X_test, y_test)
+            stream_metrics(t, train_loss_t, tl, ta)
             return params, (train_loss_t, tl, ta)
 
-        _, metrics = jax.lax.scan(body, params, (lrs, keys))
+        _, metrics = jax.lax.scan(body, params,
+                                  (jnp.arange(rounds), lrs, keys))
         return jnp.stack(metrics)
 
     return train
@@ -343,6 +367,7 @@ def _round_based(
     seed=0,
     lr_mode="reference",
     sequential=False,
+    verbose=False,
 ):
     """Common skeleton of FedAvg/FedProx/FedNova/FedAMW: scan over rounds
     of {local updates -> aggregate -> eval} (``tools.py:337-352``).
@@ -364,7 +389,7 @@ def _round_based(
         setup.num_classes, setup.num_clients, epoch, batch_size,
         setup.n_maxes, setup.bucket_counts, rounds,
         aggregation, lr_p, val_batch_size, n_val, sequential,
-        setup.mesh_devices,
+        setup.mesh_devices, verbose,
     )
 
     # Host-computed schedule from the Python-float lr: bit-identical to
@@ -403,6 +428,7 @@ def FedAvg(
     seed=0,
     lr_mode="reference",
     sequential=False,
+    verbose=False,
     **_,
 ):
     """Standard FedAvg (``tools.py:329-353``)."""
@@ -410,6 +436,7 @@ def FedAvg(
         setup, "fixed", lr, epoch, batch_size, round,
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
         seed=seed, lr_mode=lr_mode, sequential=sequential,
+        verbose=verbose,
     )
 
 
@@ -426,6 +453,7 @@ def FedProx(
     seed=0,
     lr_mode="reference",
     sequential=False,
+    verbose=False,
     **_,
 ):
     """FedAvg skeleton + proximal term (``tools.py:356-380``)."""
@@ -433,6 +461,7 @@ def FedProx(
         setup, "fixed", lr, epoch, batch_size, round,
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
         seed=seed, lr_mode=lr_mode, sequential=sequential,
+        verbose=verbose,
     )
 
 
@@ -449,6 +478,7 @@ def FedNova(
     seed=0,
     lr_mode="reference",
     sequential=False,
+    verbose=False,
     **_,
 ):
     """Normalized averaging (``tools.py:383-410``)."""
@@ -456,6 +486,7 @@ def FedNova(
         setup, "nova", lr, epoch, batch_size, round,
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
         seed=seed, lr_mode=lr_mode, sequential=sequential,
+        verbose=verbose,
     )
 
 
@@ -474,6 +505,7 @@ def FedAMW(
     seed=0,
     lr_mode="reference",
     sequential=False,
+    verbose=False,
     **_,
 ):
     """The paper's algorithm (``tools.py:413-463``): ridge-regularized
@@ -485,4 +517,5 @@ def FedAMW(
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
         lr_p=lr_p, val_batch_size=val_batch_size,
         seed=seed, lr_mode=lr_mode, sequential=sequential,
+        verbose=verbose,
     )

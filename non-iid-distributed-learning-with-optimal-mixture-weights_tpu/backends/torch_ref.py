@@ -266,7 +266,7 @@ def FedAMW_OneShot(setup, lr=0.01, epoch=200, batch_size=32, prox=False,
 
 def _rounds(setup, aggregation, lr, epoch, batch_size, rounds, mu, lam,
             lr_p=5e-5, val_batch_size=16, seed=0, lr_mode="reference",
-            sequential=False):
+            sequential=False, verbose=False):
     g = torch.Generator().manual_seed(seed)
     w = _init_weights(setup, seed)
     p = setup.p_fixed
@@ -297,41 +297,48 @@ def _rounds(setup, aggregation, lr, epoch, batch_size, rounds, mu, lam,
         else:
             w = _weighted_average(stacked, agg_w)
         test_loss[t], test_acc[t] = _evaluate(w, setup)
+        if verbose:  # reference per-round eval print (tools.py:236)
+            print(f"[round {t:3d}] train loss {train_loss[t]:8.5f} | "
+                  f"test loss {test_loss[t]:8.5f} | "
+                  f"test acc {test_acc[t]:5.1f}%", flush=True)
     return _result(train_loss, test_loss, test_acc)
 
 
 def FedAvg(setup, lr=0.01, epoch=2, batch_size=32, prox=False, mu=0.1,
            lambda_reg_if=False, lambda_reg=0.01, round=100, seed=0,
-           lr_mode="reference", sequential=False, **_):
+           lr_mode="reference", sequential=False, verbose=False, **_):
     return _rounds(setup, "fixed", lr, epoch, batch_size, round,
                    mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
-                   seed=seed, lr_mode=lr_mode, sequential=sequential)
+                   seed=seed, lr_mode=lr_mode, sequential=sequential,
+                   verbose=verbose)
 
 
 def FedProx(setup, lr=0.01, epoch=2, batch_size=32, prox=True, mu=0.1,
             lambda_reg_if=False, lambda_reg=0.01, round=100, seed=0,
-            lr_mode="reference", sequential=False, **_):
+            lr_mode="reference", sequential=False, verbose=False, **_):
     return _rounds(setup, "fixed", lr, epoch, batch_size, round,
                    mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
-                   seed=seed, lr_mode=lr_mode, sequential=sequential)
+                   seed=seed, lr_mode=lr_mode, sequential=sequential,
+                   verbose=verbose)
 
 
 def FedNova(setup, lr=0.01, epoch=2, batch_size=32, prox=False, mu=0.1,
             lambda_reg_if=False, lambda_reg=0.01, round=100, seed=0,
-            lr_mode="reference", sequential=False, **_):
+            lr_mode="reference", sequential=False, verbose=False, **_):
     return _rounds(setup, "nova", lr, epoch, batch_size, round,
                    mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
-                   seed=seed, lr_mode=lr_mode, sequential=sequential)
+                   seed=seed, lr_mode=lr_mode, sequential=sequential,
+                   verbose=verbose)
 
 
 def FedAMW(setup, lr=0.01, epoch=2, batch_size=32, prox=False, mu=0.1,
            lambda_reg_if=True, lambda_reg=0.01, round=100, lr_p=5e-5,
            val_batch_size=16, seed=0, lr_mode="reference",
-           sequential=False, **_):
+           sequential=False, verbose=False, **_):
     return _rounds(setup, "learned", lr, epoch, batch_size, round,
                    mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
                    lr_p=lr_p, val_batch_size=val_batch_size, seed=seed,
-                   lr_mode=lr_mode, sequential=sequential)
+                   lr_mode=lr_mode, sequential=sequential, verbose=verbose)
 
 
 def _result(train_loss, test_loss, test_acc):

@@ -172,9 +172,15 @@ def make_bucketed_round(
     no-op steps. Returns ``round_fn(params, X, y, idx_tuple, mask_tuple,
     keys (J, ...), lr, mu, lam)`` whose outputs are concatenated in
     bucket order (callers keep client-indexed arrays in that order).
+
+    ``sequential`` (the reference contamination artifact) chains the
+    carried parameters across buckets too: bucket g+1's first client
+    starts from bucket g's last client's weights, so the chain spans all
+    J clients. Caveat: the chain order is the size-sorted bucket order,
+    not the reference's original client order — for an order-faithful
+    A/B against the reference artifact use ``buckets=1``, which packs in
+    original order (the artifact's size is order-dependent).
     """
-    if sequential and len(n_maxes) > 1:
-        raise ValueError("sequential compat mode requires a single bucket")
     fns = [
         make_client_round(apply_fn, task, epochs, batch_size, m, sequential,
                           shard_factor)
@@ -185,15 +191,18 @@ def make_bucketed_round(
         offsets.append(offsets[-1] + c)
 
     def round_fn(params, X, y, idx_tuple, mask_tuple, keys, lr, mu, lam):
-        outs = [
-            fn(
-                params, X, y, idx_g, mask_g,
+        outs = []
+        carry = params
+        for g, (fn, idx_g, mask_g) in enumerate(
+            zip(fns, idx_tuple, mask_tuple)
+        ):
+            out = fn(
+                carry, X, y, idx_g, mask_g,
                 keys[offsets[g] : offsets[g + 1]], lr, mu, lam,
             )
-            for g, (fn, idx_g, mask_g) in enumerate(
-                zip(fns, idx_tuple, mask_tuple)
-            )
-        ]
+            outs.append(out)
+            if sequential:  # next bucket continues from the last client
+                carry = jax.tree.map(lambda s: s[-1], out[0])
         stacked = jax.tree.map(
             lambda *xs: jnp.concatenate(xs, axis=0), *[o[0] for o in outs]
         )

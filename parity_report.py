@@ -1,0 +1,65 @@
+"""Generate PARITY.md: JAX-vs-torch accuracy parity at the reference
+operating point (digits, 50 clients, alpha=0.01, D=2000, R=100,
+n_repeats=3 — reference ``exp.py:31-41``).
+
+Parity criterion per algorithm: the reference's own significance test
+(``functions/utils.py:351-353``, paired t > 1.812) applied in BOTH
+directions across seed-repeats — parity holds when neither backend
+significantly beats the other (the "identical final test accuracy"
+north star, made statistical because torch/JAX RNG streams cannot match
+bitwise; SURVEY.md §2.3.4).
+
+Usage: python parity_report.py results_parity/jax/exp1_digits.pkl \
+           results_parity/torch/exp1_digits.pkl > PARITY.md
+"""
+
+import sys
+
+import numpy as np
+
+from fedamw_tpu.utils.reporting import check_significance, load_results
+
+
+def final_acc(res):
+    # (6, R, n_repeats) -> final-round accuracies per algorithm: (6, n_repeats)
+    return np.asarray(res["test_acc"])[:, -1, :]
+
+
+def main(jax_pkl, torch_pkl):
+    rj, rt = load_results(jax_pkl), load_results(torch_pkl)
+    assert rj["name"] == rt["name"]
+    aj, at = final_acc(rj), final_acc(rt)
+
+    print("# PARITY — JAX-TPU vs torch-CPU at the reference operating point")
+    print()
+    print("digits, 50 clients, Dirichlet alpha=0.01, D=2000 RFF, 100 rounds,")
+    print("2 local epochs, batch 32, n_repeats=3 (seeds 100/101/102) — the")
+    print("reference driver's constants (`/root/reference/exp.py:31-41`).")
+    print("Parity = the reference's own t-test (threshold 1.812,")
+    print("`functions/utils.py:351-353`) finds NO significant winner in")
+    print("either direction across seed-repeats.")
+    print()
+    print("| Algorithm | JAX acc (mean±std) | torch acc (mean±std) | "
+          "Δmean | parity |")
+    print("|---|---|---|---|---|")
+    ok = True
+    for i, name in enumerate(rj["name"]):
+        jm, js = aj[i].mean(), aj[i].std()
+        tm, ts = at[i].mean(), at[i].std()
+        jax_beats = check_significance(at[i], aj[i])
+        torch_beats = check_significance(aj[i], at[i])
+        par = not (jax_beats or torch_beats)
+        ok &= par
+        print(f"| {name} | {jm:.2f}±{js:.2f} | {tm:.2f}±{ts:.2f} | "
+              f"{jm - tm:+.2f} | {'YES' if par else 'NO'} |")
+    print()
+    print(f"Overall: {'ALL SIX ALGORITHMS IN PARITY' if ok else 'PARITY FAILURES — see table'}.")
+    print()
+    print("Heterogeneity scores (same partition stream, must match closely):")
+    print(f"JAX {np.asarray(rj['heterogeneity']).round(4).tolist()} vs "
+          f"torch {np.asarray(rt['heterogeneity']).round(4).tolist()}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1], sys.argv[2]))
