@@ -62,6 +62,10 @@ def main():
     acc_mat = np.empty((6, R, args.n_repeats))
     hete = np.empty(args.n_repeats)
 
+    if args.profile and args.backend != "jax":
+        print("--profile captures a jax.profiler trace; ignored for "
+              f"backend={args.backend}")
+        args.profile = None
     if args.profile:  # opt-in jax.profiler trace of the whole run
         import jax
 

@@ -58,6 +58,63 @@ def epoch_gather_bytes(
     return J * num_batches * batch_size * D * itemsize
 
 
+_KERNEL_IMPLS = ("auto", "xla", "pallas", "pallas_interpret")
+
+# Backends whose devices are TPUs (pallas/mosaic can lower). "axon" is
+# the remote-attach TPU plugin used on single-chip dev boxes.
+_TPU_BACKENDS = ("tpu", "axon")
+
+
+def _pallas_compatible(params) -> bool:
+    """The fused kernel needs exactly the linear model's structure: a
+    flat single-entry dict holding one 2-D matrix (what the pallas
+    branch unpacks and what the hand-derived gradient is exact for)."""
+    return (
+        isinstance(params, dict)
+        and len(params) == 1
+        and all(getattr(v, "ndim", None) == 2 for v in params.values())
+    )
+
+
+def resolve_kernel_impl(kernel_impl: str, params,
+                        use_epoch_gather: bool) -> str:
+    """Resolve the client-kernel implementation at trace time.
+
+    The fused Pallas epoch kernel applies only to the flagship linear
+    model (its gradients are hand-derived) on a TPU backend, and it
+    consumes the epoch-gathered batch buffer — so it is never selected
+    (even when forced) for incompatible params or step-gather mode,
+    where it would crash or materialize the buffer the step path exists
+    to avoid. Everything else uses the XLA scan kernel.
+    FEDAMW_KERNEL=xla|pallas overrides an 'auto' argument only; an
+    explicit argument wins.
+    """
+    import os
+
+    if kernel_impl == "auto":
+        forced = os.environ.get("FEDAMW_KERNEL")
+        if forced:
+            if forced not in _KERNEL_IMPLS:
+                raise ValueError(
+                    f"FEDAMW_KERNEL={forced!r}; expected one of "
+                    f"{_KERNEL_IMPLS}"
+                )
+            kernel_impl = forced
+    if kernel_impl.startswith("pallas"):
+        interpret = kernel_impl == "pallas_interpret"
+        if _pallas_compatible(params) and use_epoch_gather and (
+            interpret or jax.default_backend() in _TPU_BACKENDS
+        ):
+            return kernel_impl
+        return "xla"
+    # auto currently resolves to the XLA kernel even on TPU: the Pallas
+    # path is numerically pinned against it in interpreter mode
+    # (tests/test_pallas_kernel.py) but not yet validated on the axon
+    # remote-attach lowering — opt in with FEDAMW_KERNEL=pallas or an
+    # explicit kernel_impl until that validation lands.
+    return "xla"
+
+
 def make_local_update(
     apply_fn: Callable,
     task: str,
@@ -65,6 +122,7 @@ def make_local_update(
     batch_size: int,
     n_max: int,
     gather_mode: str = "auto",
+    kernel_impl: str = "auto",
 ):
     """Build the single-client local-SGD kernel.
 
@@ -122,11 +180,27 @@ def make_local_update(
             )
             <= EPOCH_GATHER_BYTES_LIMIT
         )
+        impl = resolve_kernel_impl(kernel_impl, params, use_epoch_gather)
 
         def epoch_body(p, key_e):
             # Fresh shuffle: valid rows first in random order, padding last.
             b_pos, b_valid = epoch_batches(key_e, n_max, batch_size, mask)
             rows = idx[b_pos]  # (n_batches, B)
+
+            if impl.startswith("pallas"):
+                from .pallas_kernel import make_pallas_epoch
+
+                (wkey,) = p.keys()  # flat single-matrix dict (resolver)
+                C, D = p[wkey].shape
+                epoch_fn = make_pallas_epoch(
+                    task, C, D, batch_size, num_batches,
+                    interpret=(impl == "pallas_interpret"),
+                )
+                scal = jnp.stack([lr, mu, lam]).astype(jnp.float32)
+                w, met = epoch_fn(p[wkey], anchor[wkey], X[rows], y[rows],
+                                  b_valid, scal)
+                total = jnp.maximum(met[2], 1.0)
+                return {wkey: w}, (met[0] / total, 100.0 * met[1] / total)
 
             if use_epoch_gather:
                 xs = (X[rows], y[rows], b_valid)
@@ -163,6 +237,7 @@ def make_bucketed_round(
     bucket_counts: tuple[int, ...],
     sequential: bool = False,
     shard_factor: int = 1,
+    kernel_impl: str = "auto",
 ):
     """Client round over size-bucketed packs (``data.bucket_partitions``).
 
@@ -183,7 +258,7 @@ def make_bucketed_round(
     """
     fns = [
         make_client_round(apply_fn, task, epochs, batch_size, m, sequential,
-                          shard_factor)
+                          shard_factor, kernel_impl)
         for m in n_maxes
     ]
     offsets = [0]
@@ -221,6 +296,7 @@ def make_client_round(
     n_max: int,
     sequential: bool = False,
     shard_factor: int = 1,
+    kernel_impl: str = "auto",
 ):
     """Lift the kernel over the client axis.
 
@@ -240,7 +316,8 @@ def make_client_round(
     protects — is the global size over this factor.
     """
     kernels = {
-        m: make_local_update(apply_fn, task, epochs, batch_size, n_max, m)
+        m: make_local_update(apply_fn, task, epochs, batch_size, n_max, m,
+                             kernel_impl)
         for m in ("epoch", "step")
     }
 
