@@ -45,6 +45,11 @@ def parse_args():
                          "jitted round scans (reference tools.py:236)")
     ap.add_argument("--profile", type=str, default=None, metavar="DIR",
                     help="capture a jax.profiler trace of the run to DIR")
+    ap.add_argument("--save_models", type=str, default=None, metavar="DIR",
+                    help="checkpoint each round-based algorithm's final "
+                         "global params + mixture weights under DIR "
+                         "(orbax when available; the reference persists "
+                         "metrics only)")
     return ap.parse_args()
 
 
@@ -150,6 +155,12 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete):
         round_common = dict(epoch=args.local_epoch, round=R,
                             lr_mode=args.lr_mode, verbose=args.verbose,
                             **common)
+        if args.save_models:
+            if args.backend == "jax":
+                round_common["return_state"] = True
+            elif t == 0:
+                print("--save_models is implemented for the jax backend; "
+                      f"ignored for backend={args.backend}")
         avg = algos["FedAvg"](setup, lr=lr, **round_common)
         prox = algos["FedProx"](setup, lr=lr, prox=True, mu=mu, **round_common)
         amw = algos["FedAMW"](setup, lr=lr, lambda_reg_if=True,
@@ -160,6 +171,15 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete):
             error_mat[row, :, t] = res["test_loss"]
             acc_mat[row, :, t] = res["test_acc"]
             print(f"{name}: final acc {res['test_acc'][-1]:.2f}")
+            if "params" in res:
+                from fedamw_tpu.utils.checkpoint import save_checkpoint
+
+                where = save_checkpoint(
+                    os.path.join(args.save_models,
+                                 f"{args.dataset}_{name}_repeat{t}"),
+                    res["params"], p=res["p"], round_idx=R,
+                )
+                print(f"{name}: checkpoint -> {where}")
         print(f"[repeat {t}] wall time {time.time() - t0:.1f}s "
               f"(backend={args.backend})")
 

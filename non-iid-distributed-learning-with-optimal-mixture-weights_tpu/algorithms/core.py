@@ -193,7 +193,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 body, (params, p, opt_state),
                 (jnp.arange(rounds), lrs, keys, pkeys),
             )
-            return jnp.stack(metrics)
+            return jnp.stack(metrics), params, p
 
         return train
 
@@ -218,9 +218,9 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
             stream_metrics(t, train_loss_t, tl, ta)
             return params, (train_loss_t, tl, ta)
 
-        _, metrics = jax.lax.scan(body, params,
-                                  (jnp.arange(rounds), lrs, keys))
-        return jnp.stack(metrics)
+        params, metrics = jax.lax.scan(body, params,
+                                       (jnp.arange(rounds), lrs, keys))
+        return jnp.stack(metrics), params, p_fixed
 
     return train
 
@@ -368,6 +368,7 @@ def _round_based(
     lr_mode="reference",
     sequential=False,
     verbose=False,
+    return_state=False,
 ):
     """Common skeleton of FedAvg/FedProx/FedNova/FedAMW: scan over rounds
     of {local updates -> aggregate -> eval} (``tools.py:337-352``).
@@ -399,20 +400,26 @@ def _round_based(
     lrs = lr_schedule_array(lr, rounds, lr_mode)
 
     if aggregation == "learned":
-        metrics = train(
+        metrics, fparams, fp = train(
             seed, setup.X, setup.y, idx_tup, mask_tup,
             setup.X_val, setup.y_val, setup.X_test, setup.y_test,
             lrs, setup.p_fixed, setup.sizes, float(mu), float(lam),
         )
     else:
-        metrics = train(
+        metrics, fparams, fp = train(
             seed, setup.X, setup.y, idx_tup, mask_tup,
             setup.X_test, setup.y_test, lrs,
             setup.p_fixed, setup.sizes, float(mu), float(lam),
         )
 
     metrics = np.asarray(metrics)
-    return result_tuple(metrics[0], metrics[1], metrics[2])
+    out = result_tuple(metrics[0], metrics[1], metrics[2])
+    if return_state:
+        # final global model + mixture weights, for checkpointing
+        # (utils/checkpoint.py); left on device unless the caller saves
+        out["params"] = fparams
+        out["p"] = fp
+    return out
 
 
 def FedAvg(
@@ -429,6 +436,7 @@ def FedAvg(
     lr_mode="reference",
     sequential=False,
     verbose=False,
+    return_state=False,
     **_,
 ):
     """Standard FedAvg (``tools.py:329-353``)."""
@@ -436,7 +444,7 @@ def FedAvg(
         setup, "fixed", lr, epoch, batch_size, round,
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
         seed=seed, lr_mode=lr_mode, sequential=sequential,
-        verbose=verbose,
+        verbose=verbose, return_state=return_state,
     )
 
 
@@ -454,6 +462,7 @@ def FedProx(
     lr_mode="reference",
     sequential=False,
     verbose=False,
+    return_state=False,
     **_,
 ):
     """FedAvg skeleton + proximal term (``tools.py:356-380``)."""
@@ -461,7 +470,7 @@ def FedProx(
         setup, "fixed", lr, epoch, batch_size, round,
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
         seed=seed, lr_mode=lr_mode, sequential=sequential,
-        verbose=verbose,
+        verbose=verbose, return_state=return_state,
     )
 
 
@@ -479,6 +488,7 @@ def FedNova(
     lr_mode="reference",
     sequential=False,
     verbose=False,
+    return_state=False,
     **_,
 ):
     """Normalized averaging (``tools.py:383-410``)."""
@@ -486,7 +496,7 @@ def FedNova(
         setup, "nova", lr, epoch, batch_size, round,
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
         seed=seed, lr_mode=lr_mode, sequential=sequential,
-        verbose=verbose,
+        verbose=verbose, return_state=return_state,
     )
 
 
@@ -506,6 +516,7 @@ def FedAMW(
     lr_mode="reference",
     sequential=False,
     verbose=False,
+    return_state=False,
     **_,
 ):
     """The paper's algorithm (``tools.py:413-463``): ridge-regularized
@@ -517,5 +528,5 @@ def FedAMW(
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
         lr_p=lr_p, val_batch_size=val_batch_size,
         seed=seed, lr_mode=lr_mode, sequential=sequential,
-        verbose=verbose,
+        verbose=verbose, return_state=return_state,
     )

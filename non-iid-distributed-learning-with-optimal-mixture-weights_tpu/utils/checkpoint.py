@@ -1,0 +1,74 @@
+"""Optional model checkpointing (orbax-backed, plain-pickle fallback).
+
+The reference persists ONLY the final metric matrices
+(``/root/reference/exp.py:132-143``) — no model state, no resume. This
+module adds the optional capability the SURVEY §5 plan called for:
+saving ``(global_params, mixture_weights, round)`` per algorithm so a
+trained model can be reloaded for inference or a run can be resumed.
+Orbax is used when importable (the standard JAX checkpointing library,
+async-safe, device-aware); otherwise a plain pickle of host arrays.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+
+def _to_host(tree):
+    import jax
+
+    return jax.tree.map(lambda x: np.asarray(x), tree)
+
+
+def save_checkpoint(path: str, params, p=None, round_idx: int | None = None,
+                    extra: dict | None = None) -> str:
+    """Save algorithm state under ``path`` (a directory). Returns the
+    path actually written."""
+    state: dict[str, Any] = {"params": _to_host(params)}
+    if p is not None:
+        state["p"] = np.asarray(p)
+    if round_idx is not None:
+        state["round"] = int(round_idx)
+    if extra:
+        state.update(extra)
+    os.makedirs(path, exist_ok=True)
+    try:
+        import orbax.checkpoint as ocp
+
+        ckpt = os.path.join(os.path.abspath(path), "orbax")
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(ckpt, state, force=True)
+        return ckpt
+    except Exception:
+        out = os.path.join(path, "state.pkl")
+        with open(out, "wb") as f:
+            pickle.dump(state, f)
+        return out
+
+
+def load_checkpoint(path: str) -> dict:
+    """Load a checkpoint written by :func:`save_checkpoint` (either
+    layout)."""
+    orbax_dir = os.path.join(path, "orbax")
+    if os.path.isdir(orbax_dir):
+        import orbax.checkpoint as ocp
+
+        with ocp.PyTreeCheckpointer() as ckptr:
+            return ckptr.restore(os.path.abspath(orbax_dir))
+    pkl = os.path.join(path, "state.pkl")
+    if os.path.exists(pkl):
+        with open(pkl, "rb") as f:
+            return pickle.load(f)
+    if os.path.isdir(path) and os.path.exists(
+        os.path.join(path, "_CHECKPOINT_METADATA")
+    ):
+        # a bare orbax dir was passed directly
+        import orbax.checkpoint as ocp
+
+        with ocp.PyTreeCheckpointer() as ckptr:
+            return ckptr.restore(os.path.abspath(path))
+    raise FileNotFoundError(f"no checkpoint under {path}")
