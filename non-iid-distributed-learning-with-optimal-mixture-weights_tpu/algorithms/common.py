@@ -100,6 +100,7 @@ def prepare_setup(
     n_max: int | None = None,
     buckets: int = 1,
     client_multiple: int = 1,
+    feature_dtype=None,
 ) -> FedSetup:
     """Build the device-resident setup from a loaded dataset.
 
@@ -117,6 +118,14 @@ def prepare_setup(
     single unbucketed axis) with inert empty clients to a multiple, so
     the setup shards evenly over a mesh of that many devices — this is
     how bucketing and mesh sharding compose (``parallel.shard_setup``).
+
+    ``feature_dtype`` (e.g. ``jnp.bfloat16``) stores the mapped feature
+    matrices in a narrower dtype — the dominant HBM resident and gather
+    traffic halve; compute stays float32 (the matmul against float32
+    weights promotes). RFF features live in [-1/sqrt(D), 1/sqrt(D)],
+    comfortably inside bfloat16's dynamic range; accuracy impact is
+    small and test-pinned (``tests/test_bf16.py``). Model params,
+    labels, and all loss math remain float32.
     """
     if rng is None:
         rng = np.random.RandomState(seed)
@@ -127,14 +136,20 @@ def prepare_setup(
     X_train = jnp.asarray(ds.X_train)
     X_test = jnp.asarray(ds.X_test)
     if kernel_type == "gaussian":
+        from ..ops.rff import rff_map_to
+
         W, b = rff_params(key, ds.d, D, kernel_par)
-        X_train = rff_map(X_train, W, b)
-        X_test = rff_map(X_test, W, b)
+        out_dtype = feature_dtype or jnp.float32
+        X_train = rff_map_to(X_train, W, b, out_dtype)
+        X_test = rff_map_to(X_test, W, b, out_dtype)
         rff = (W, b)
         feat_dim = D
     else:
         rff = None
         feat_dim = ds.d
+        if feature_dtype is not None:
+            X_train = X_train.astype(feature_dtype)
+            X_test = X_test.astype(feature_dtype)
 
     train_parts, val_idx = split_train_val(ds.parts, val_fraction, rng)
 

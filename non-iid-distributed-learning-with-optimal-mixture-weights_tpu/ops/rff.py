@@ -35,6 +35,25 @@ def rff_map(X: jax.Array, W: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.cos(X @ W + b) / jnp.sqrt(jnp.float32(D))
 
 
+def rff_map_to(X, W, b, out_dtype, chunk: int = 65536):
+    """RFF-map into a narrower dtype without the full-width transient.
+
+    ``rff_map(X).astype(bf16)`` would materialize the full float32
+    ``(N, D)`` matrix before converting — a 1.5x-of-f32 HBM peak in
+    exactly the at-the-limit regime a narrow dtype targets. Mapping in
+    row chunks keeps only one f32 chunk live at a time; the final
+    resident is the narrow matrix alone.
+    """
+    n = X.shape[0]
+    if n <= chunk:
+        return rff_map(X, W, b).astype(out_dtype)
+    parts = [
+        rff_map(X[lo : min(lo + chunk, n)], W, b).astype(out_dtype)
+        for lo in range(0, n, chunk)
+    ]
+    return jnp.concatenate(parts, axis=0)
+
+
 def rff_map_sparse(X_sparse, W, b, chunk: int = 8192):
     """RFF-map a scipy sparse matrix without densifying the input.
 

@@ -1,6 +1,7 @@
 from .mesh import (
     CLIENT_AXIS,
     client_spec,
+    initialize_multihost,
     make_mesh,
     replicated,
     shard_client_keys,
@@ -10,6 +11,7 @@ from .mesh import (
 __all__ = [
     "CLIENT_AXIS",
     "client_spec",
+    "initialize_multihost",
     "make_mesh",
     "replicated",
     "shard_client_keys",
