@@ -29,6 +29,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..fedcore import (
     client_logits,
@@ -44,10 +45,9 @@ from .common import FedSetup, result_tuple
 
 
 # The two seed derivations below are the single source of truth for how
-# a driver seed becomes round keys and initial parameters. They work
-# both eagerly (one-shot algorithms) and traced inside the jitted
-# round trainers — the derivation must stay identical so seed-matched
-# cross-algorithm comparisons start from the same state.
+# a driver seed becomes round keys and initial parameters — traced
+# inside every jitted trainer, so seed-matched cross-algorithm
+# comparisons start from the same state.
 
 def _keys(seed, *shape):
     return jax.random.split(jax.random.PRNGKey(seed), shape)
@@ -57,10 +57,6 @@ def _derive_params(init_fn, seed, D: int, num_classes: int):
     return init_fn(
         jax.random.fold_in(jax.random.PRNGKey(seed), 7), D, num_classes
     )
-
-
-def _init_params(setup: FedSetup, seed: int):
-    return _derive_params(setup.model.init, seed, setup.D, setup.num_classes)
 
 
 def _print_round(t, train_loss, test_loss, test_acc):
@@ -74,54 +70,10 @@ def _print_round(t, train_loss, test_loss, test_acc):
     )
 
 
-# All kernel factories below are memoized on their static configuration.
+# All trainer factories below are memoized on their static configuration.
 # jit caches by function identity — rebuilding a closure per algorithm
-# call would recompile the whole round scan every time (and the first
+# call would recompile the whole program every time (and the first
 # "warmup" call would cache nothing).
-
-_cached_local_update = functools.lru_cache(maxsize=128)(
-    lambda apply_fn, task, epochs, batch_size, n: jax.jit(
-        make_local_update(apply_fn, task, epochs, batch_size, n)
-    )
-)
-
-_cached_bucketed_round = functools.lru_cache(maxsize=128)(
-    lambda apply_fn, task, epochs, batch_size, n_maxes, counts,
-    sequential=False, shard_factor=1: jax.jit(
-        make_bucketed_round(
-            apply_fn, task, epochs, batch_size, n_maxes, counts, sequential,
-            shard_factor,
-        )
-    )
-)
-
-_cached_evaluator = functools.lru_cache(maxsize=32)(make_evaluator)
-
-
-@functools.lru_cache(maxsize=64)
-def _cached_oneshot_p_phase(apply_fn, task, n_val, val_batch_size, lr_p):
-    """Jitted one-shot mixture phase: per iteration one p-epoch (plain
-    SGD), re-aggregate, eval."""
-    solve, init_opt = make_p_solver(task, n_val, val_batch_size, lr_p,
-                                    momentum=0.0)
-    evaluate = make_evaluator(apply_fn, task)
-
-    @jax.jit
-    def p_phase(p, opt_state, logits, stacked, y_val, X_test, y_test, pkeys,
-                client_valid):
-        def body(carry, key_t):
-            p, opt_state = carry
-            p, opt_state, _, _ = solve(logits, y_val, p, opt_state, key_t, 1,
-                                       client_valid=client_valid)
-            g = weighted_average(stacked, p)
-            tl, ta = evaluate(g, X_test, y_test)
-            return (p, opt_state), (tl, ta)
-
-        (p, opt_state), (tls, tas) = jax.lax.scan(body, (p, opt_state), pkeys)
-        return p, tls, tas
-
-    return p_phase, init_opt
-
 
 @functools.lru_cache(maxsize=64)
 def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
@@ -225,6 +177,29 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
     return train
 
 
+@functools.lru_cache(maxsize=64)
+def _cached_centralized_trainer(init_fn, apply_fn, task, D, num_classes,
+                                epoch, batch_size, n):
+    """One jitted program for the Centralized baseline: init, the long
+    pooled local run, eval — one dispatch (see _cached_round_trainer on
+    why eager steps are expensive on remote-attached TPUs)."""
+    lu = make_local_update(apply_fn, task, epoch, batch_size, n)
+    evaluate = make_evaluator(apply_fn, task)
+
+    @jax.jit
+    def train(seed, X, y, all_idx, X_test, y_test, lr):
+        params = _derive_params(init_fn, seed, D, num_classes)
+        params, train_loss, _ = lu(
+            params, X, y, all_idx, jnp.ones(n, jnp.float32),
+            jax.random.PRNGKey(seed), lr, jnp.float32(0.0),
+            jnp.float32(0.0),
+        )
+        tl, ta = evaluate(params, X_test, y_test)
+        return jnp.stack([train_loss, tl, ta])
+
+    return train
+
+
 def Centralized(
     setup: FedSetup,
     lr=0.01,
@@ -237,48 +212,102 @@ def Centralized(
     (reference ``tools.py:240-255``; called with epoch*Round epochs)."""
     all_idx = setup.all_train_idx
     n = int(all_idx.shape[0])
-    lu = _cached_local_update(setup.model.apply, setup.task, epoch, batch_size, n)
-    params = _init_params(setup, seed)
-    params, train_loss, _ = lu(
-        params,
-        setup.X,
-        setup.y,
-        all_idx,
-        jnp.ones(n, jnp.float32),
-        jax.random.PRNGKey(seed),
-        jnp.float32(lr),
-        jnp.float32(0.0),
-        jnp.float32(0.0),
+    train = _cached_centralized_trainer(
+        setup.model.init, setup.model.apply, setup.task, setup.D,
+        setup.num_classes, epoch, batch_size, n,
     )
-    evaluate = _cached_evaluator(setup.model.apply, setup.task)
-    test_loss, test_acc = evaluate(params, setup.X_test, setup.y_test)
-    return result_tuple(train_loss, test_loss, test_acc)
+    m = np.asarray(train(seed, setup.X, setup.y, all_idx,
+                         setup.X_test, setup.y_test, float(lr)))
+    return result_tuple(m[0], m[1], m[2])
 
 
-def _one_shot_local_phase(setup, lr, epoch, batch_size, mu, lam, seed,
-                          sequential=False):
-    """Shared by Distributed and FedAMW_OneShot: every client trains
-    epoch*Round epochs from the same init, once."""
-    round_fn = _cached_bucketed_round(
-        setup.model.apply, setup.task, epoch, batch_size,
+# The one-shot algorithms split into TWO jitted programs: the long
+# epoch*Round local phase (shared — Distributed and FedAMW_OneShot run
+# it with the same config, so it compiles ONCE per config) and a small
+# per-algorithm finish program. Cost: one extra dispatch round-trip;
+# benefit: the dominant compile happens once, not per algorithm.
+
+@functools.lru_cache(maxsize=64)
+def _cached_oneshot_local(init_fn, apply_fn, task, D, num_classes,
+                          num_clients, epoch, batch_size, n_maxes, counts,
+                          sequential, shard_factor):
+    """Jitted one-shot local phase: init + every client training
+    epoch*Round epochs from the same init (``tools.py:261-267``)."""
+    round_fn = make_bucketed_round(apply_fn, task, epoch, batch_size,
+                                   n_maxes, counts, sequential=sequential,
+                                   shard_factor=shard_factor)
+
+    @jax.jit
+    def local_phase(seed, X, y, idx, mask, lr, mu, lam):
+        params = _derive_params(init_fn, seed, D, num_classes)
+        keys = _keys(seed, num_clients)
+        stacked, losses, _ = round_fn(params, X, y, idx, mask, keys,
+                                      lr, mu, lam)
+        return stacked, losses
+
+    return local_phase
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_distributed_finish(apply_fn, task):
+    """Fixed-weight aggregation + eval (``tools.py:269-276``)."""
+    evaluate = make_evaluator(apply_fn, task)
+
+    @jax.jit
+    def finish(stacked, losses, p_fixed, X_test, y_test):
+        train_loss = jnp.sum(p_fixed * losses)
+        g = weighted_average(stacked, p_fixed)
+        tl, ta = evaluate(g, X_test, y_test)
+        return jnp.stack([train_loss, tl, ta])
+
+    return finish
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_oneshot_finish(apply_fn, task, rounds, lr_p, val_batch_size,
+                           n_val):
+    """FedAMW_OneShot mixture phase: ``rounds`` iterations of plain-SGD
+    p-learning over cached logits, re-aggregating and evaluating after
+    each (``tools.py:279-326``). Returns one flat
+    ``[train_loss, test_losses(rounds), test_accs(rounds)]`` vector so
+    the host fetch is a single transfer."""
+    solve, init_opt = make_p_solver(task, n_val, val_batch_size, lr_p,
+                                    momentum=0.0)
+    evaluate = make_evaluator(apply_fn, task)
+
+    @jax.jit
+    def finish(seed, stacked, losses, p0, sizes, X_val, y_val,
+               X_test, y_test):
+        train_loss = jnp.sum(p0 * losses)
+        logits = client_logits(apply_fn, stacked, X_val)
+        client_valid = (sizes > 0).astype(jnp.float32)
+        pkeys = jax.random.split(jax.random.PRNGKey(seed + 1), rounds)
+
+        def body(carry, key_t):
+            p, opt_state = carry
+            p, opt_state, _, _ = solve(logits, y_val, p, opt_state, key_t, 1,
+                                       client_valid=client_valid)
+            g = weighted_average(stacked, p)
+            tl, ta = evaluate(g, X_test, y_test)
+            return (p, opt_state), (tl, ta)
+
+        _, (tls, tas) = jax.lax.scan(body, (p0, init_opt(p0)), pkeys)
+        return jnp.concatenate([train_loss[None], tls, tas])
+
+    return finish
+
+
+def _oneshot_local_phase(setup, epoch, batch_size, sequential, seed,
+                         lr, mu, lam):
+    idx_tup, mask_tup = setup.round_arrays()
+    local = _cached_oneshot_local(
+        setup.model.init, setup.model.apply, setup.task, setup.D,
+        setup.num_classes, setup.num_clients, epoch, batch_size,
         setup.n_maxes, setup.bucket_counts, sequential,
         setup.mesh_devices,
     )
-    params = _init_params(setup, seed)
-    keys = _keys(seed, setup.num_clients)
-    idx_tup, mask_tup = setup.round_arrays()
-    stacked, losses, accs = round_fn(
-        params,
-        setup.X,
-        setup.y,
-        idx_tup,
-        mask_tup,
-        keys,
-        jnp.float32(lr),
-        jnp.float32(mu),
-        jnp.float32(lam),
-    )
-    return stacked, losses
+    return local(seed, setup.X, setup.y, idx_tup, mask_tup,
+                 float(lr), float(mu), float(lam))
 
 
 def Distributed(
@@ -295,17 +324,14 @@ def Distributed(
     **_,
 ):
     """One-shot FL with fixed sample-count weights (``tools.py:258-276``)."""
-    stacked, losses = _one_shot_local_phase(
-        setup, lr, epoch, batch_size,
-        mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0, seed,
-        sequential=sequential,
+    stacked, losses = _oneshot_local_phase(
+        setup, epoch, batch_size, sequential, seed, lr,
+        mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
     )
-    p = setup.p_fixed
-    train_loss = jnp.sum(p * losses)
-    global_params = weighted_average(stacked, p)
-    evaluate = _cached_evaluator(setup.model.apply, setup.task)
-    test_loss, test_acc = evaluate(global_params, setup.X_test, setup.y_test)
-    return result_tuple(train_loss, test_loss, test_acc)
+    finish = _cached_distributed_finish(setup.model.apply, setup.task)
+    m = np.asarray(finish(stacked, losses, setup.p_fixed,
+                          setup.X_test, setup.y_test))
+    return result_tuple(m[0], m[1], m[2])
 
 
 def FedAMW_OneShot(
@@ -329,28 +355,19 @@ def FedAMW_OneShot(
     evaluating after each (``tools.py:279-326``). The reference's
     client-0 aliasing bug (weights rescaled by p[0] every iteration) is
     deliberately not reproduced."""
-    stacked, losses = _one_shot_local_phase(
-        setup, lr, epoch, batch_size,
-        mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0, seed,
-        sequential=sequential,
+    stacked, losses = _oneshot_local_phase(
+        setup, epoch, batch_size, sequential, seed, lr,
+        mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
     )
-    p0 = setup.p_fixed
-    train_loss = jnp.sum(p0 * losses)
-
     n_val = int(setup.X_val.shape[0])
-    p_phase, init_opt = _cached_oneshot_p_phase(
-        setup.model.apply, setup.task, n_val, val_batch_size, lr_p
+    finish = _cached_oneshot_finish(
+        setup.model.apply, setup.task, round, lr_p, val_batch_size, n_val,
     )
-    logits = jax.jit(client_logits, static_argnums=0)(
-        setup.model.apply, stacked, setup.X_val
-    )
-    pkeys = _keys(seed + 1, round)
-    _, test_loss, test_acc = p_phase(
-        p0, init_opt(p0), logits, stacked, setup.y_val,
-        setup.X_test, setup.y_test, pkeys,
-        (setup.sizes > 0).astype(jnp.float32),
-    )
-    return result_tuple(train_loss, test_loss, test_acc)
+    m = np.asarray(finish(
+        seed, stacked, losses, setup.p_fixed, setup.sizes,
+        setup.X_val, setup.y_val, setup.X_test, setup.y_test,
+    ))
+    return result_tuple(m[0], m[1 : 1 + round], m[1 + round :])
 
 
 def _round_based(
@@ -380,8 +397,6 @@ def _round_based(
     call is ONE dispatch + ONE (3, rounds) metric fetch (remote-TPU
     round-trips dominate otherwise; see _cached_round_trainer).
     """
-    import numpy as np
-
     n_val = int(setup.X_val.shape[0])
     idx_tup, mask_tup = setup.round_arrays()
 
