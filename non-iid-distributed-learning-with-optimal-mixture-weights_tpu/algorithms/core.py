@@ -38,6 +38,7 @@ from ..fedcore import (
     make_evaluator,
     make_local_update,
     make_p_solver,
+    participation_weights,
     weighted_average,
 )
 from ..ops.schedule import lr_schedule_array
@@ -79,7 +80,8 @@ def _print_round(t, train_loss, test_loss, test_acc):
 def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                           epoch, batch_size, n_maxes, counts, rounds,
                           aggregation, lr_p, val_batch_size, n_val,
-                          sequential, shard_factor, verbose=False):
+                          sequential, shard_factor, verbose=False,
+                          participation=1.0):
     """The full jitted training run for the round-based algorithms: one
     lax.scan over rounds. Memoized so repeated runs (sweeps, benchmarks,
     NNI trials) reuse the compiled program.
@@ -158,20 +160,42 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                                               batch_size)
         else:
             agg_w = p_fixed
+        # partial participation (extension; the reference trains every
+        # client every round, tools.py:340): per-round Bernoulli mask
+        # over the real (non-padded) clients, weights renormalized over
+        # the participating subset; an all-absent round is a no-op.
+        part_keys = jax.random.split(jax.random.PRNGKey(seed + 2), rounds)
+        valid = (sizes > 0).astype(jnp.float32)
 
         def body(params, inp):
-            t, lr_t, keys_t = inp
+            t, lr_t, keys_t, part_key_t = inp
             stacked, losses, _ = round_fn(
                 params, X, y, idx, mask, keys_t, lr_t, mu, lam,
             )
-            train_loss_t = jnp.sum(p_fixed * losses)
-            params = weighted_average(stacked, agg_w)
+            if participation < 1.0:
+                part = valid * (
+                    jax.random.uniform(part_key_t, valid.shape)
+                    < participation
+                ).astype(jnp.float32)
+                w_t = participation_weights(agg_w, part)
+                loss_w = participation_weights(p_fixed, part)
+                new_params = weighted_average(stacked, w_t)
+                any_part = jnp.sum(part) > 0
+                params = jax.tree.map(
+                    lambda new, old: jnp.where(any_part, new, old),
+                    new_params, params,
+                )
+                train_loss_t = jnp.sum(loss_w * losses)
+            else:
+                train_loss_t = jnp.sum(p_fixed * losses)
+                params = weighted_average(stacked, agg_w)
             tl, ta = evaluate(params, X_test, y_test)
             stream_metrics(t, train_loss_t, tl, ta)
             return params, (train_loss_t, tl, ta)
 
-        params, metrics = jax.lax.scan(body, params,
-                                       (jnp.arange(rounds), lrs, keys))
+        params, metrics = jax.lax.scan(
+            body, params, (jnp.arange(rounds), lrs, keys, part_keys)
+        )
         return jnp.stack(metrics), params, p_fixed
 
     return train
@@ -386,6 +410,7 @@ def _round_based(
     sequential=False,
     verbose=False,
     return_state=False,
+    participation=1.0,
 ):
     """Common skeleton of FedAvg/FedProx/FedNova/FedAMW: scan over rounds
     of {local updates -> aggregate -> eval} (``tools.py:337-352``).
@@ -397,6 +422,10 @@ def _round_based(
     call is ONE dispatch + ONE (3, rounds) metric fetch (remote-TPU
     round-trips dominate otherwise; see _cached_round_trainer).
     """
+    if not 0.0 < participation <= 1.0:
+        raise ValueError(f"participation must be in (0, 1], got "
+                         f"{participation}")
+
     n_val = int(setup.X_val.shape[0])
     idx_tup, mask_tup = setup.round_arrays()
 
@@ -405,7 +434,7 @@ def _round_based(
         setup.num_classes, setup.num_clients, epoch, batch_size,
         setup.n_maxes, setup.bucket_counts, rounds,
         aggregation, lr_p, val_batch_size, n_val, sequential,
-        setup.mesh_devices, verbose,
+        setup.mesh_devices, verbose, float(participation),
     )
 
     # Host-computed schedule from the Python-float lr: bit-identical to
@@ -452,6 +481,7 @@ def FedAvg(
     sequential=False,
     verbose=False,
     return_state=False,
+    participation=1.0,
     **_,
 ):
     """Standard FedAvg (``tools.py:329-353``)."""
@@ -460,6 +490,7 @@ def FedAvg(
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
         seed=seed, lr_mode=lr_mode, sequential=sequential,
         verbose=verbose, return_state=return_state,
+        participation=participation,
     )
 
 
@@ -478,6 +509,7 @@ def FedProx(
     sequential=False,
     verbose=False,
     return_state=False,
+    participation=1.0,
     **_,
 ):
     """FedAvg skeleton + proximal term (``tools.py:356-380``)."""
@@ -486,6 +518,7 @@ def FedProx(
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
         seed=seed, lr_mode=lr_mode, sequential=sequential,
         verbose=verbose, return_state=return_state,
+        participation=participation,
     )
 
 
@@ -504,6 +537,7 @@ def FedNova(
     sequential=False,
     verbose=False,
     return_state=False,
+    participation=1.0,
     **_,
 ):
     """Normalized averaging (``tools.py:383-410``)."""
@@ -512,6 +546,7 @@ def FedNova(
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
         seed=seed, lr_mode=lr_mode, sequential=sequential,
         verbose=verbose, return_state=return_state,
+        participation=participation,
     )
 
 
@@ -532,12 +567,20 @@ def FedAMW(
     sequential=False,
     verbose=False,
     return_state=False,
+    participation=1.0,
     **_,
 ):
     """The paper's algorithm (``tools.py:413-463``): ridge-regularized
     local training; per round, ``round`` epochs of mixture-weight SGD
     (momentum 0.9) on the pooled validation set over cached per-client
     logits; aggregate with the learned, unconstrained p."""
+    if participation < 1.0:
+        raise ValueError(
+            "FedAMW assumes full participation (the learned mixture "
+            "weights are fit over every client's cached logits, "
+            "tools.py:435-453); partial participation is supported for "
+            "FedAvg/FedProx/FedNova only"
+        )
     return _round_based(
         setup, "learned", lr, epoch, batch_size, round,
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,

@@ -264,9 +264,23 @@ def FedAMW_OneShot(setup, lr=0.01, epoch=200, batch_size=32, prox=False,
     return _result(train_loss, test_loss, test_acc)
 
 
+def _participation_weights(agg_w, part):
+    """Aggregation weights restricted to a participation mask, subset
+    rescaled to the full original mass (mirrors the JAX
+    fedcore.aggregate.participation_weights)."""
+    masked = agg_w * part
+    total = float(masked.sum())
+    if total <= 0:
+        return torch.zeros_like(agg_w)
+    return masked * (float(agg_w.sum()) / total)
+
+
 def _rounds(setup, aggregation, lr, epoch, batch_size, rounds, mu, lam,
             lr_p=5e-5, val_batch_size=16, seed=0, lr_mode="reference",
-            sequential=False, verbose=False):
+            sequential=False, verbose=False, participation=1.0):
+    if not 0.0 < participation <= 1.0:
+        raise ValueError(f"participation must be in (0, 1], got "
+                         f"{participation}")
     g = torch.Generator().manual_seed(seed)
     w = _init_weights(setup, seed)
     p = setup.p_fixed
@@ -287,14 +301,25 @@ def _rounds(setup, aggregation, lr, epoch, batch_size, rounds, mu, lam,
         stacked, losses, _ = _client_pass(
             setup, w, float(lrs[t]), epoch, batch_size, mu, lam, g, sequential
         )
-        train_loss[t] = float((p * losses).sum())
-        if aggregation == "learned":
+        if participation < 1.0:
+            # partial participation (extension; reference trains every
+            # client every round): per-round Bernoulli mask, weights
+            # renormalized over participants; all-absent round = no-op
+            part = (torch.rand(len(p), generator=g) < participation).float()
+            train_loss[t] = float(
+                (_participation_weights(p, part) * losses).sum())
+            if float(part.sum()) > 0:
+                w = _weighted_average(stacked,
+                                      _participation_weights(agg_w, part))
+        elif aggregation == "learned":
+            train_loss[t] = float((p * losses).sum())
             with torch.no_grad():
                 logits = torch.einsum("jcd,nd->njc", stacked, setup.X_val)
             p, buf = _solve_p(logits, setup.y_val, p, buf, lr_p, 0.9,
                               val_batch_size, rounds, setup.task, g)
             w = _weighted_average(stacked, p)
         else:
+            train_loss[t] = float((p * losses).sum())
             w = _weighted_average(stacked, agg_w)
         test_loss[t], test_acc[t] = _evaluate(w, setup)
         if verbose:  # reference per-round eval print (tools.py:236)
@@ -306,35 +331,43 @@ def _rounds(setup, aggregation, lr, epoch, batch_size, rounds, mu, lam,
 
 def FedAvg(setup, lr=0.01, epoch=2, batch_size=32, prox=False, mu=0.1,
            lambda_reg_if=False, lambda_reg=0.01, round=100, seed=0,
-           lr_mode="reference", sequential=False, verbose=False, **_):
+           lr_mode="reference", sequential=False, verbose=False,
+           participation=1.0, **_):
     return _rounds(setup, "fixed", lr, epoch, batch_size, round,
                    mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
                    seed=seed, lr_mode=lr_mode, sequential=sequential,
-                   verbose=verbose)
+                   verbose=verbose, participation=participation)
 
 
 def FedProx(setup, lr=0.01, epoch=2, batch_size=32, prox=True, mu=0.1,
             lambda_reg_if=False, lambda_reg=0.01, round=100, seed=0,
-            lr_mode="reference", sequential=False, verbose=False, **_):
+            lr_mode="reference", sequential=False, verbose=False,
+            participation=1.0, **_):
     return _rounds(setup, "fixed", lr, epoch, batch_size, round,
                    mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
                    seed=seed, lr_mode=lr_mode, sequential=sequential,
-                   verbose=verbose)
+                   verbose=verbose, participation=participation)
 
 
 def FedNova(setup, lr=0.01, epoch=2, batch_size=32, prox=False, mu=0.1,
             lambda_reg_if=False, lambda_reg=0.01, round=100, seed=0,
-            lr_mode="reference", sequential=False, verbose=False, **_):
+            lr_mode="reference", sequential=False, verbose=False,
+            participation=1.0, **_):
     return _rounds(setup, "nova", lr, epoch, batch_size, round,
                    mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
                    seed=seed, lr_mode=lr_mode, sequential=sequential,
-                   verbose=verbose)
+                   verbose=verbose, participation=participation)
 
 
 def FedAMW(setup, lr=0.01, epoch=2, batch_size=32, prox=False, mu=0.1,
            lambda_reg_if=True, lambda_reg=0.01, round=100, lr_p=5e-5,
            val_batch_size=16, seed=0, lr_mode="reference",
-           sequential=False, verbose=False, **_):
+           sequential=False, verbose=False, participation=1.0, **_):
+    if participation < 1.0:  # same contract as the JAX backend
+        raise ValueError(
+            "FedAMW assumes full participation; partial participation is "
+            "supported for FedAvg/FedProx/FedNova only"
+        )
     return _rounds(setup, "learned", lr, epoch, batch_size, round,
                    mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
                    lr_p=lr_p, val_batch_size=val_batch_size, seed=seed,

@@ -2,6 +2,7 @@ from .aggregate import (
     client_logits,
     fednova_effective_weights,
     make_p_solver,
+    participation_weights,
     weighted_average,
 )
 from .client import make_bucketed_round, make_client_round, make_local_update
@@ -11,6 +12,7 @@ __all__ = [
     "client_logits",
     "fednova_effective_weights",
     "make_p_solver",
+    "participation_weights",
     "weighted_average",
     "make_bucketed_round",
     "make_client_round",

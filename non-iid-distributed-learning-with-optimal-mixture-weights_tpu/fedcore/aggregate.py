@@ -59,6 +59,25 @@ def fednova_effective_weights(
     return jnp.where(tau > 0, p * tau_eff / safe_tau, 0.0)
 
 
+def participation_weights(agg_w: jax.Array, part: jax.Array) -> jax.Array:
+    """Aggregation weights restricted to a participation mask.
+
+    Partial client participation (an extension — the reference always
+    uses every client, ``tools.py:340``): zero the weights of absent
+    clients and rescale so the participating subset carries the full
+    original mass ``sum(agg_w)``. For FedAvg's sample-count weights
+    (summing to 1) this is the standard partial-participation
+    renormalization; for FedNova it preserves the tau-scaled total.
+    An all-absent round returns all-zero weights (callers keep the old
+    global params in that case).
+    """
+    masked = agg_w * part
+    total = jnp.sum(masked)
+    scale = jnp.where(total > 0, jnp.sum(agg_w) / jnp.maximum(total, 1e-30),
+                      0.0)
+    return masked * scale
+
+
 def client_logits(apply_fn: Callable, stacked_params, X: jax.Array) -> jax.Array:
     """Per-client predictions on a shared matrix: ``(J, n, C) -> (n, J, C)``.
 

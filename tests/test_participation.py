@@ -1,0 +1,86 @@
+"""Partial client participation (extension — the reference trains every
+client every round, ``tools.py:340``).
+
+Per round a Bernoulli mask picks the participating clients; aggregation
+weights renormalize over them (subset carries the full original mass);
+an all-absent round leaves the global model unchanged; FedAMW rejects
+the option (its learned mixture weights assume full participation).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedamw_tpu.algorithms import FedAMW, FedAvg, prepare_setup
+from fedamw_tpu.backends import torch_ref
+from fedamw_tpu.data import load_dataset
+from fedamw_tpu.fedcore import participation_weights
+
+
+def test_participation_weights_preserve_mass():
+    w = jnp.asarray([0.5, 0.3, 0.2])
+    part = jnp.asarray([1.0, 0.0, 1.0])
+    out = np.asarray(participation_weights(w, part))
+    assert out[1] == 0.0
+    np.testing.assert_allclose(out.sum(), 1.0, atol=1e-6)
+    # relative weights among participants preserved
+    np.testing.assert_allclose(out[0] / out[2], 0.5 / 0.2, rtol=1e-5)
+
+
+def test_participation_weights_all_absent_is_zero():
+    w = jnp.asarray([0.6, 0.4])
+    out = np.asarray(participation_weights(w, jnp.zeros(2)))
+    np.testing.assert_array_equal(out, np.zeros(2))
+
+
+@pytest.fixture(scope="module")
+def setup8():
+    ds = load_dataset("digits", num_partitions=8, alpha=0.5)
+    return prepare_setup(ds, kernel_type="linear", seed=3,
+                         rng=np.random.RandomState(3))
+
+
+def test_full_participation_matches_default(setup8):
+    kw = dict(lr=0.5, epoch=1, round=3, seed=0, lr_mode="constant")
+    a = FedAvg(setup8, **kw)
+    b = FedAvg(setup8, participation=1.0, **kw)
+    np.testing.assert_array_equal(a["test_acc"], b["test_acc"])
+
+
+def test_partial_participation_runs_and_differs(setup8):
+    kw = dict(lr=0.5, epoch=1, round=4, seed=0, lr_mode="constant")
+    full = FedAvg(setup8, **kw)
+    half = FedAvg(setup8, participation=0.5, **kw)
+    assert np.all(np.isfinite(half["test_loss"]))
+    assert not np.allclose(full["train_loss"], half["train_loss"])
+    assert half["test_acc"][-1] > 30.0  # still learns
+
+
+def test_fedamw_rejects_partial_participation(setup8):
+    with pytest.raises(ValueError, match="full participation"):
+        FedAMW(setup8, participation=0.5, round=2)
+
+
+def test_torch_fedamw_rejects_partial_participation():
+    ds = load_dataset("digits", num_partitions=4, alpha=0.5)
+    s = torch_ref.prepare_setup(ds, kernel_type="linear", seed=3,
+                                rng=np.random.RandomState(3))
+    with pytest.raises(ValueError, match="full participation"):
+        torch_ref.FedAMW(s, participation=0.5, round=2)
+
+
+@pytest.mark.parametrize("bad", [0.0, -0.5, 1.5])
+def test_participation_out_of_range_rejected(setup8, bad):
+    with pytest.raises(ValueError, match="participation"):
+        FedAvg(setup8, participation=bad, round=2)
+
+
+def test_torch_backend_participation():
+    ds = load_dataset("digits", num_partitions=6, alpha=0.5)
+    s = torch_ref.prepare_setup(ds, kernel_type="linear", seed=3,
+                                rng=np.random.RandomState(3))
+    kw = dict(lr=0.5, epoch=1, round=3, seed=0, lr_mode="constant")
+    full = torch_ref.FedAvg(s, **kw)
+    half = torch_ref.FedAvg(s, participation=0.5, **kw)
+    assert np.all(np.isfinite(half["test_loss"]))
+    assert not np.allclose(full["train_loss"], half["train_loss"])
