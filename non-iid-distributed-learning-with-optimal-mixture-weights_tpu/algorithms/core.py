@@ -224,16 +224,30 @@ def _cached_centralized_trainer(init_fn, apply_fn, task, D, num_classes,
     return train
 
 
+
+def _reject_partial(participation, algo: str):
+    """One-shot algorithms have no per-round participation concept; a
+    silently ignored participation<1 would mislabel a full-participation
+    run as partial (round-based FedAMW already rejects loudly)."""
+    if participation != 1.0:
+        raise ValueError(
+            f"{algo} assumes full participation (it has no communication "
+            f"rounds to sample clients in); got participation="
+            f"{participation}")
+
+
 def Centralized(
     setup: FedSetup,
     lr=0.01,
     epoch=200,
     batch_size=32,
     seed=0,
+    participation=1.0,
     **_,
 ):
     """Upper-bound baseline: all shards pooled, one long local run
     (reference ``tools.py:240-255``; called with epoch*Round epochs)."""
+    _reject_partial(participation, "Centralized")
     all_idx = setup.all_train_idx
     n = int(all_idx.shape[0])
     train = _cached_centralized_trainer(
@@ -345,9 +359,11 @@ def Distributed(
     lambda_reg=0.01,
     seed=0,
     sequential=False,
+    participation=1.0,
     **_,
 ):
     """One-shot FL with fixed sample-count weights (``tools.py:258-276``)."""
+    _reject_partial(participation, "Distributed")
     stacked, losses = _oneshot_local_phase(
         setup, epoch, batch_size, sequential, seed, lr,
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
@@ -372,6 +388,7 @@ def FedAMW_OneShot(
     val_batch_size=16,
     seed=0,
     sequential=False,
+    participation=1.0,
     **_,
 ):
     """One long local phase, then ``round`` iterations of mixture-weight
@@ -379,6 +396,7 @@ def FedAMW_OneShot(
     evaluating after each (``tools.py:279-326``). The reference's
     client-0 aliasing bug (weights rescaled by p[0] every iteration) is
     deliberately not reproduced."""
+    _reject_partial(participation, "FedAMW_OneShot")
     stacked, losses = _oneshot_local_phase(
         setup, epoch, batch_size, sequential, seed, lr,
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
@@ -425,6 +443,18 @@ def _round_based(
     if not 0.0 < participation <= 1.0:
         raise ValueError(f"participation must be in (0, 1], got "
                          f"{participation}")
+    if sequential and participation < 1.0:
+        # The sequential-compat chain (client i+1 starts from client i's
+        # weights, reference tools.py:341) has no defined semantics for
+        # an absent client: the static-shape scan here would let absent
+        # clients train and contaminate the chain while the torch loop
+        # skips them — two different algorithms. Refuse the combination
+        # on both backends rather than silently diverge.
+        raise ValueError(
+            "sequential=True cannot compose with participation<1 (an "
+            "absent client has no defined place in the reference's "
+            "sequential contamination chain); use parallel semantics "
+            "(sequential=False) for partial participation")
 
     n_val = int(setup.X_val.shape[0])
     idx_tup, mask_tup = setup.round_arrays()

@@ -169,11 +169,25 @@ def _evaluate(w, setup: TorchSetup):
 
 
 def _client_pass(setup, w_global, lr, epochs, batch_size, mu, lam, generator,
-                 sequential=False):
-    """All clients' local updates for one round."""
+                 sequential=False, active=None):
+    """All clients' local updates for one round.
+
+    ``active`` (optional 0/1 mask) skips absent clients' training
+    entirely — their stacked entry is the unchanged input weights and
+    their loss 0, both of which the caller multiplies by a zero
+    aggregation weight. Unlike the JAX scan (static shapes force dense
+    compute there), this Python loop recovers the ~1/participation
+    speedup; a skipped client does not advance the sequential
+    contamination chain (it never trained).
+    """
     stacked, losses, accs = [], [], []
     w_in = w_global
-    for part in setup.parts:
+    for j, part in enumerate(setup.parts):
+        if active is not None and not bool(active[j]):
+            stacked.append(w_in.clone())
+            losses.append(0.0)
+            accs.append(0.0)
+            continue
         w_j, l_j, a_j = _local_sgd(
             w_in, setup, part, lr, epochs, batch_size, mu, lam, generator
         )
@@ -214,7 +228,21 @@ def _solve_p(logits, y_val, p, buf, lr_p, momentum, batch_size, epochs, task,
     return p.detach(), buf
 
 
-def Centralized(setup, lr=0.01, epoch=200, batch_size=32, seed=0, **_):
+
+def _reject_partial(participation, algo: str):
+    """Mirror of algorithms.core._reject_partial: one-shot algorithms
+    have no per-round participation concept; refuse rather than silently
+    ignore the option."""
+    if participation != 1.0:
+        raise ValueError(
+            f"{algo} assumes full participation (it has no communication "
+            f"rounds to sample clients in); got participation="
+            f"{participation}")
+
+
+def Centralized(setup, lr=0.01, epoch=200, batch_size=32, seed=0,
+                participation=1.0, **_):
+    _reject_partial(participation, "Centralized")
     g = torch.Generator().manual_seed(seed)
     all_idx = torch.cat(setup.parts)
     w, train_loss, _ = _local_sgd(
@@ -227,7 +255,8 @@ def Centralized(setup, lr=0.01, epoch=200, batch_size=32, seed=0, **_):
 
 def Distributed(setup, lr=0.01, epoch=200, batch_size=32, prox=False, mu=0.1,
                 lambda_reg_if=False, lambda_reg=0.01, seed=0,
-                sequential=False, **_):
+                sequential=False, participation=1.0, **_):
+    _reject_partial(participation, "Distributed")
     g = torch.Generator().manual_seed(seed)
     stacked, losses, _ = _client_pass(
         setup, _init_weights(setup, seed), lr, epoch, batch_size,
@@ -242,7 +271,9 @@ def Distributed(setup, lr=0.01, epoch=200, batch_size=32, prox=False, mu=0.1,
 
 def FedAMW_OneShot(setup, lr=0.01, epoch=200, batch_size=32, prox=False,
                    mu=0.1, lambda_reg_if=True, lambda_reg=0.01, round=100,
-                   lr_p=5e-5, val_batch_size=16, seed=0, sequential=False, **_):
+                   lr_p=5e-5, val_batch_size=16, seed=0, sequential=False,
+                   participation=1.0, **_):
+    _reject_partial(participation, "FedAMW_OneShot")
     g = torch.Generator().manual_seed(seed)
     stacked, losses, _ = _client_pass(
         setup, _init_weights(setup, seed), lr, epoch, batch_size,
@@ -281,6 +312,14 @@ def _rounds(setup, aggregation, lr, epoch, batch_size, rounds, mu, lam,
     if not 0.0 < participation <= 1.0:
         raise ValueError(f"participation must be in (0, 1], got "
                          f"{participation}")
+    if sequential and participation < 1.0:
+        # same rejection as the JAX backend (algorithms/core.py): an
+        # absent client has no defined place in the sequential chain
+        raise ValueError(
+            "sequential=True cannot compose with participation<1 (an "
+            "absent client has no defined place in the reference's "
+            "sequential contamination chain); use parallel semantics "
+            "(sequential=False) for partial participation")
     g = torch.Generator().manual_seed(seed)
     w = _init_weights(setup, seed)
     p = setup.p_fixed
@@ -297,18 +336,29 @@ def _rounds(setup, aggregation, lr, epoch, batch_size, rounds, mu, lam,
     train_loss = np.zeros(rounds)
     test_loss = np.zeros(rounds)
     test_acc = np.zeros(rounds)
+    valid = (torch.tensor(np.asarray(setup.sizes)) > 0).float()
     for t in range(rounds):
-        stacked, losses, _ = _client_pass(
-            setup, w, float(lrs[t]), epoch, batch_size, mu, lam, g, sequential
-        )
+        part = None
         if participation < 1.0:
             # partial participation (extension; reference trains every
-            # client every round): per-round Bernoulli mask, weights
-            # renormalized over participants; all-absent round = no-op
-            part = (torch.rand(len(p), generator=g) < participation).float()
+            # client every round): per-round Bernoulli mask over the
+            # real (non-empty) clients — an empty client has zero
+            # aggregation weight, so letting it "participate" alone
+            # would pass a headcount gate yet zero the global model —
+            # weights renormalized over participants; all-absent round
+            # = no-op. Mirrors the JAX path's `valid` mask
+            # (algorithms/core.py). Drawn BEFORE the client pass so
+            # absent clients skip local training entirely.
+            part = valid * (
+                torch.rand(len(p), generator=g) < participation).float()
+        stacked, losses, _ = _client_pass(
+            setup, w, float(lrs[t]), epoch, batch_size, mu, lam, g,
+            sequential, active=part,
+        )
+        if part is not None:
             train_loss[t] = float(
                 (_participation_weights(p, part) * losses).sum())
-            if float(part.sum()) > 0:
+            if float((agg_w * part).sum()) > 0:
                 w = _weighted_average(stacked,
                                       _participation_weights(agg_w, part))
         elif aggregation == "learned":

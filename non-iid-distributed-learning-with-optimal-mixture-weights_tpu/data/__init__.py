@@ -1,4 +1,5 @@
 from .datasets import FederatedDataset, load_dataset
+from .loaders import MinibatchLoader, load_data
 from .pack import ClientPack, pack_partitions, split_train_val
 from .partition import dirichlet_partition, uniform_partition
 from .svmlight import canonicalize_labels, is_regression, load_svmlight
@@ -7,6 +8,8 @@ from .synthetic import generate_synthetic, synthetic_classification
 __all__ = [
     "FederatedDataset",
     "load_dataset",
+    "MinibatchLoader",
+    "load_data",
     "ClientPack",
     "pack_partitions",
     "split_train_val",

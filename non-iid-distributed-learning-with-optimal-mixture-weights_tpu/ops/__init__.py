@@ -8,7 +8,13 @@ from .losses import (
     ridge_penalty,
     training_loss,
 )
-from .metrics import Meter, comp_accuracy, masked_accuracy, top1_correct
+from .metrics import (
+    Meter,
+    comp_accuracy,
+    error_estimate,
+    masked_accuracy,
+    top1_correct,
+)
 from .rff import data_heterogeneity, feature_mapping, rff_map, rff_params
 from .schedule import lr_schedule_array, update_learning_rate
 
@@ -23,6 +29,7 @@ __all__ = [
     "training_loss",
     "Meter",
     "comp_accuracy",
+    "error_estimate",
     "masked_accuracy",
     "top1_correct",
     "data_heterogeneity",

@@ -41,6 +41,31 @@ def comp_accuracy(output, target, topk=(1,)):
     return res
 
 
+def error_estimate(output, target, task_type: str = "regression"):
+    """MSE + top-1 error pair (reference ``functions/tools.py:64-79``).
+
+    The reference marks this "(useless)" and never calls it; it is
+    reproduced for API completeness. For ``binary``/``multiclass`` (or
+    this repo's ``classification``) the MSE is taken against the one-hot
+    encoding of ``target`` and the second element is the top-1 error
+    rate (1 - acc/100); for ``regression`` both elements are the plain
+    MSE. Returns Python floats, as the reference's ``.item()`` calls do.
+    """
+    output = np.asarray(output, np.float32)
+    target = np.asarray(target)
+    if task_type in ("binary", "multiclass", "classification"):
+        top1 = comp_accuracy(output, target)[0]
+        onehot = np.eye(output.shape[-1], dtype=np.float32)[
+            target.astype(np.int64)
+        ]
+        mse = float(np.mean((output - onehot) ** 2))
+        return mse, 1.0 - top1 / 100.0
+    if task_type == "regression":
+        mse = float(np.mean((output - target) ** 2))
+        return mse, mse
+    raise ValueError(f"Unsupported task type: {task_type}")
+
+
 class Meter:
     """Streaming mean/std/MAD accumulator (reference ``tools.py:99-166``)."""
 
