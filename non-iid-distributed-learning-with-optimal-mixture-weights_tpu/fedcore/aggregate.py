@@ -89,12 +89,35 @@ def client_logits(apply_fn: Callable, stacked_params, X: jax.Array) -> jax.Array
     return jnp.transpose(preds, (1, 0, 2))
 
 
+def resolve_psolver_impl(kernel_impl: str = "auto") -> str:
+    """Pick the p-solver implementation: 'xla' or 'pallas'[_interpret].
+
+    Mirrors ``client.resolve_kernel_impl``: FEDAMW_PSOLVER=xla|pallas
+    overrides an 'auto' argument; 'auto' currently resolves to XLA
+    everywhere — the Pallas path is numerically pinned against it in
+    interpreter mode (tests/test_pallas_psolver.py) but hardware
+    validation on the axon remote-attach lowering is pending, and the
+    interpret-mode kernel is a test vehicle (far slower than XLA on
+    CPU). Opt in with FEDAMW_PSOLVER=pallas.
+    """
+    import os
+
+    if kernel_impl == "auto":
+        forced = os.environ.get("FEDAMW_PSOLVER", "").strip().lower()
+        if forced in ("xla", "pallas", "pallas_interpret"):
+            kernel_impl = forced
+        else:
+            kernel_impl = "xla"
+    return kernel_impl
+
+
 def make_p_solver(
     task: str,
     n_val: int,
     batch_size: int = 16,
     lr_p: float = 1e-3,
     momentum: float = 0.0,
+    kernel_impl: str = "auto",
 ):
     """Build the jitted mixture-weight SGD solver.
 
@@ -124,6 +147,7 @@ def make_p_solver(
 
     def init_opt_state(p):
         return tx.init(p)
+
 
     def batch_loss(p, logits_b, y_b, valid_b):
         out = jnp.einsum("bjc,j->bc", logits_b, p)
@@ -195,4 +219,70 @@ def make_p_solver(
         )
         return p, opt_state, ep_losses[-1], ep_accs[-1]
 
+    kernel_impl = resolve_psolver_impl(kernel_impl)
+    if kernel_impl.startswith("pallas"):
+        return _make_pallas_solve(
+            task, n_val, batch_size, lr_p, momentum,
+            interpret=kernel_impl == "pallas_interpret",
+            fallback=solve,
+        ), init_opt_state
     return solve, init_opt_state
+
+
+def _make_pallas_solve(task, n_val, batch_size, lr_p, momentum, interpret,
+                       fallback):
+    """Fused-kernel drop-in for the XLA ``solve`` (same signature and
+    RNG stream; semantics pinned in ``tests/test_pallas_psolver.py``).
+
+    The optax opt_state is carried through unchanged in structure: its
+    single trace leaf (momentum>0) is threaded through the kernel's
+    momentum buffer; for momentum=0 the buffer is a per-call zero
+    (plain SGD has no cross-call state, and ``buf = 0*buf + g`` makes
+    the in-kernel update degenerate to ``p -= lr*g``).
+    """
+    from .batching import epoch_batches
+    from .pallas_psolver import make_pallas_p_epoch
+
+    def solve(logits, y_val, p, opt_state, key, num_epochs: int,
+              client_valid=None):
+        from .client import EPOCH_GATHER_BYTES_LIMIT
+
+        J, C = logits.shape[1], logits.shape[2]
+        n_batches = -(-n_val // batch_size)
+        # the kernel consumes the epoch-gathered class-major buffer;
+        # past the gather budget (scale configs: J in the thousands)
+        # keep the XLA per-step-gather path instead of materializing GBs
+        buf_bytes = n_batches * batch_size * J * C * logits.dtype.itemsize
+        if buf_bytes > EPOCH_GATHER_BYTES_LIMIT:
+            return fallback(logits, y_val, p, opt_state, key, num_epochs,
+                            client_valid)
+        p_epoch = make_pallas_p_epoch(task, C, J, batch_size, n_batches,
+                                      interpret)
+        scal = jnp.asarray([lr_p, momentum], jnp.float32)
+        cv = (jnp.ones((1, J), jnp.float32) if client_valid is None
+              else client_valid.reshape(1, J).astype(jnp.float32))
+        leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+        buf = leaves[0].reshape(1, J) if leaves else jnp.zeros(
+            (1, J), jnp.float32)
+
+        def epoch_body(carry, key_e):
+            p, buf = carry
+            b_idx, b_valid = epoch_batches(key_e, n_val, batch_size)
+            # class-major gather: (S, B, J, C) -> (S, C, B, J) so each
+            # kernel step sees clean 2-D (B, J) matvec operands
+            lb = jnp.transpose(logits[b_idx], (0, 3, 1, 2))
+            yb = y_val[b_idx]
+            p, buf, met = p_epoch(p, buf, cv, lb, yb, b_valid, scal)
+            total = jnp.maximum(met[2], 1.0)
+            return (p, buf), (met[0] / total, 100.0 * met[1] / total)
+
+        keys = jax.random.split(key, num_epochs)
+        (p2, buf), (ep_losses, ep_accs) = jax.lax.scan(
+            epoch_body, (p.reshape(1, J), buf), keys
+        )
+        new_state = (jax.tree_util.tree_unflatten(
+            treedef, [buf.reshape(leaves[0].shape)]) if leaves
+            else opt_state)
+        return p2.reshape(p.shape), new_state, ep_losses[-1], ep_accs[-1]
+
+    return solve

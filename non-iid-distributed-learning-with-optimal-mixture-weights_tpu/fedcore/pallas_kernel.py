@@ -46,8 +46,13 @@ def _epoch_kernel(
     a_ref,        # (C, D) prox anchor: the client's ROUND-incoming params
                   # (tools.py:180) — differs from w0 after the 1st epoch
     x_ref,        # (1, B, D) this step's batch features
-    y_ref,        # (1, B) labels (int32 classification / f32 regression)
-    bv_ref,       # (1, B) batch-validity mask
+    y_ref,        # (1, 1, B) labels (int32 classification / f32 regression)
+                  #   — the singleton middle axis keeps the block's last
+                  #   two dims equal to the array's (Mosaic requires
+                  #   last-two block dims divisible by (8, 128) or equal
+                  #   to the array dims; a (1, B) block over an (S, B)
+                  #   array satisfies neither)
+    bv_ref,       # (1, 1, B) batch-validity mask (same layout)
     scal_ref,     # (3,) SMEM: lr, mu, lam
     w_out_ref,    # (C, D) final weights
     met_ref,      # (1, 3) loss*cnt sum, correct sum, cnt sum
@@ -67,7 +72,7 @@ def _epoch_kernel(
     w = w_ref[:]
     anchor = a_ref[:]
     xb = x_ref[0]                      # (B, D)
-    bv = bv_ref[0].astype(jnp.float32)  # (B,)
+    bv = bv_ref[0, 0].astype(jnp.float32)  # (B,)
     lr, mu, lam = scal_ref[0], scal_ref[1], scal_ref[2]
 
     cnt = jnp.sum(bv)
@@ -75,7 +80,7 @@ def _epoch_kernel(
     z = jnp.dot(xb, w.T, preferred_element_type=jnp.float32)  # (B, C)
 
     if task_is_classification:
-        y = y_ref[0]                   # (B,) int32
+        y = y_ref[0, 0]                # (B,) int32
         zmax = jnp.max(z, axis=-1, keepdims=True)
         ez = jnp.exp(z - zmax)
         Z = jnp.sum(ez, axis=-1, keepdims=True)
@@ -86,11 +91,17 @@ def _epoch_kernel(
         # CE per example: logsumexp - z[label]
         per = (jnp.log(Z[:, 0]) + zmax[:, 0]) - jnp.sum(z * onehot, axis=-1)
         dz = (softmax - onehot) * (bv * inv_cnt)[:, None]   # (B, C)
-        correct = jnp.sum(
-            (jnp.argmax(z, axis=-1) == y).astype(jnp.float32) * bv
-        )
+        # top-1 correctness as a fully 2-D reduction: Mosaic cannot yet
+        # lower the 1-D (B,)-shaped compare/sum chain ("Offset change"),
+        # so compare the keepdims argmax against a 2-D iota and reduce
+        # the (B, C) product in one shot.
+        pred = jnp.argmax(z, axis=-1, keepdims=True)        # (B, 1)
+        first_max = (
+            jax.lax.broadcasted_iota(jnp.int32, (B, C), 1) == pred
+        ).astype(jnp.float32)
+        correct = jnp.sum(first_max * onehot * bv[:, None])
     else:
-        y = y_ref[0].astype(jnp.float32)
+        y = y_ref[0, 0].astype(jnp.float32)
         err = z - y[:, None]           # (B, C); mean over C per example
         per = jnp.mean(jnp.square(err), axis=-1)
         dz = err * (2.0 / C) * (bv * inv_cnt)[:, None]
@@ -150,9 +161,9 @@ def make_pallas_epoch(task: str, C: int, D: int, B: int, S: int,
                              memory_space=pltpu.VMEM),
                 pl.BlockSpec((1, B, D), lambda s: (s, 0, 0),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, B), lambda s: (s, 0),
+                pl.BlockSpec((1, 1, B), lambda s: (s, 0, 0),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, B), lambda s: (s, 0),
+                pl.BlockSpec((1, 1, B), lambda s: (s, 0, 0),
                              memory_space=pltpu.VMEM),
                 pl.BlockSpec(memory_space=pltpu.SMEM),
             ],
@@ -171,7 +182,8 @@ def make_pallas_epoch(task: str, C: int, D: int, B: int, S: int,
                 pltpu.SMEM((3,), jnp.float32),
             ],
             interpret=interpret,
-        )(w0, anchor, Xe, ye.astype(y_dtype), bv, scal)
+        )(w0, anchor, Xe, ye.astype(y_dtype)[:, None, :],
+          bv[:, None, :], scal)
         return w, met[0]
 
     return epoch

@@ -1,0 +1,192 @@
+"""Fused Pallas TPU kernel for the FedAMW mixture-weight (p) solver.
+
+The XLA p-solver (``fedcore/aggregate.py:make_p_solver``) runs the
+reference's ``round x |val|/16`` tiny SGD steps (``tools.py:441-453``)
+as a ``lax.scan`` whose per-step cost is pure op overhead (~1.8 us on a
+v5e chip for a (16, J, C) einsum + a (J,) momentum update — well under
+1% MXU utilization). This kernel fuses one whole validation epoch into
+one Pallas program: ``p`` and its momentum buffer live in VMEM scratch
+across a grid over batch steps, and each step's pre-gathered logits
+block streams HBM->VMEM through the BlockSpec pipeline.
+
+Semantics are pinned against the XLA solver in
+``tests/test_pallas_psolver.py``:
+- identical shuffle stream (the caller gathers with the same
+  ``epoch_batches`` indices), masked-mean batch loss, last partial
+  batch handling;
+- torch-identical SGD(momentum) update ``buf = m*buf + g;
+  p -= lr*buf`` (optax ``trace`` with Nesterov off);
+- ``client_valid`` zeroes the gradient (and thus the momentum) of
+  padded clients every step, exactly as the XLA path.
+
+Mosaic constraints shape the layout: every tensor the kernel reduces is
+kept 2-D (1-D (B,)-shaped chains fail to lower — "Offset change"), the
+logits block arrives as (C, B, J) so each class slice is a clean
+(B, J) matvec operand, and labels/masks ride as (B, 1) columns.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _p_epoch_kernel(
+    task_is_classification: bool,
+    C: int,
+    J: int,
+    B: int,
+    p0_ref,      # (1, J) epoch-start mixture weights
+    buf0_ref,    # (1, J) epoch-start momentum buffer
+    cv_ref,      # (1, J) client-validity mask (1s when unused)
+    l_ref,       # (1, C, B, J) this step's logits block, class-major
+    y_ref,       # (1, B, 1) labels (int32 cls / f32 reg), column layout
+    bv_ref,      # (1, B, 1) batch-validity mask, column layout
+    scal_ref,    # (2,) SMEM: lr_p, momentum
+    p_out_ref,   # (1, J) final p
+    buf_out_ref,  # (1, J) final momentum buffer
+    met_ref,     # (1, 3) SMEM: loss*cnt sum, correct sum, cnt sum
+    p_ref,       # VMEM scratch: live p
+    buf_ref,     # VMEM scratch: live momentum buffer
+    acc_ref,     # SMEM scratch: metric accumulators
+):
+    s = pl.program_id(0)
+    S = pl.num_programs(0)
+
+    @pl.when(s == 0)
+    def _init():
+        p_ref[:] = p0_ref[:]
+        buf_ref[:] = buf0_ref[:]
+        acc_ref[0] = 0.0
+        acc_ref[1] = 0.0
+        acc_ref[2] = 0.0
+
+    p = p_ref[:]                        # (1, J)
+    lb = l_ref[0]                       # (C, B, J)
+    bvc = bv_ref[0].astype(jnp.float32)  # (B, 1)
+    lr, mom = scal_ref[0], scal_ref[1]
+
+    cnt = jnp.sum(bvc)
+    inv_cnt = 1.0 / jnp.maximum(cnt, 1.0)
+    p_col = p.reshape(J, 1)
+
+    # z[:, c] = lb[c] @ p — C static tiny, unrolled; each term is a
+    # (B, J) x (J, 1) matvec on the MXU
+    z = jnp.concatenate(
+        [jnp.dot(lb[c], p_col, preferred_element_type=jnp.float32)
+         for c in range(C)], axis=1)    # (B, C)
+
+    if task_is_classification:
+        yc = y_ref[0]                   # (B, 1) int32
+        zmax = jnp.max(z, axis=-1, keepdims=True)
+        ez = jnp.exp(z - zmax)
+        Z = jnp.sum(ez, axis=-1, keepdims=True)
+        softmax = ez / Z
+        onehot = (
+            jax.lax.broadcasted_iota(jnp.int32, (B, C), 1) == yc
+        ).astype(jnp.float32)
+        per = (jnp.log(Z) + zmax) - jnp.sum(
+            z * onehot, axis=-1, keepdims=True)             # (B, 1)
+        d = (softmax - onehot) * (bvc * inv_cnt)            # (B, C)
+        pred = jnp.argmax(z, axis=-1, keepdims=True)        # (B, 1)
+        first_max = (
+            jax.lax.broadcasted_iota(jnp.int32, (B, C), 1) == pred
+        ).astype(jnp.float32)
+        correct = jnp.sum(first_max * onehot * bvc)
+    else:
+        yc = y_ref[0].astype(jnp.float32)                   # (B, 1)
+        err = z - yc                    # (B, C) via broadcast
+        per = jnp.sum(jnp.square(err), axis=-1, keepdims=True) / C
+        d = err * (2.0 / C) * (bvc * inv_cnt)
+        correct = 0.0
+
+    loss = jnp.sum(per * bvc) * inv_cnt
+
+    # g_j = sum_{b,c} lb[c,b,j] * d[b,c]: per class a transposed matvec
+    # (d_c^T @ lb[c]) contracting the B (sublane) dim on the MXU
+    g = jnp.zeros((1, J), jnp.float32)
+    for c in range(C):
+        g = g + jax.lax.dot_general(
+            d[:, c : c + 1], lb[c], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (1, J)
+    g = g * cv_ref[:]
+
+    # torch/optax SGD(momentum): buf = m*buf + g; p -= lr*buf. The XLA
+    # path steps unconditionally (epoch_batches never yields an empty
+    # batch), so no cnt guard here either.
+    buf = mom * buf_ref[:] + g
+    buf_ref[:] = buf
+    p_ref[:] = p - lr * buf
+
+    acc_ref[0] = acc_ref[0] + loss * cnt
+    acc_ref[1] = acc_ref[1] + correct
+    acc_ref[2] = acc_ref[2] + cnt
+
+    @pl.when(s == S - 1)
+    def _fin():
+        p_out_ref[:] = p_ref[:]
+        buf_out_ref[:] = buf_ref[:]
+        met_ref[0, 0] = acc_ref[0]
+        met_ref[0, 1] = acc_ref[1]
+        met_ref[0, 2] = acc_ref[2]
+
+
+@functools.lru_cache(maxsize=64)
+def make_pallas_p_epoch(task: str, C: int, J: int, B: int, S: int,
+                        interpret: bool = False):
+    """Build ``p_epoch(p (1,J), buf (1,J), cv (1,J), lb (S,C,B,J),
+    yb (S,B,1), bv (S,B,1), scal (2,)) -> (p, buf, metrics (3,))`` — one
+    full shuffled pass over the pooled validation set as one fused
+    Pallas program. ``scal`` packs (lr_p, momentum)."""
+    kernel = functools.partial(
+        _p_epoch_kernel, task == "classification", C, J, B
+    )
+    y_dtype = jnp.int32 if task == "classification" else jnp.float32
+
+    def p_epoch(p, buf, cv, lb, yb, bv, scal):
+        p, buf, met = pl.pallas_call(
+            kernel,
+            grid=(S,),
+            in_specs=[
+                pl.BlockSpec((1, J), lambda s: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, J), lambda s: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, J), lambda s: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, C, B, J), lambda s: (s, 0, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, B, 1), lambda s: (s, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, B, 1), lambda s: (s, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, J), lambda s: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, J), lambda s: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, 3), lambda s: (0, 0),
+                             memory_space=pltpu.SMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((1, J), jnp.float32),
+                jax.ShapeDtypeStruct((1, J), jnp.float32),
+                jax.ShapeDtypeStruct((1, 3), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((1, J), jnp.float32),
+                pltpu.VMEM((1, J), jnp.float32),
+                pltpu.SMEM((3,), jnp.float32),
+            ],
+            interpret=interpret,
+        )(p, buf, cv, lb, yb.astype(y_dtype)[..., None],
+          bv[..., None], scal)
+        return p, buf, met[0]
+
+    return p_epoch

@@ -185,3 +185,20 @@ def test_shard_setup_rejects_uneven_bucket():
                           rng=np.random.RandomState(1), buckets=3)
     with pytest.raises(ValueError, match="divisible"):
         shard_setup(setup, make_mesh())
+
+
+def test_participation_sharded_matches_unsharded(setup8):
+    """Partial participation draws its Bernoulli mask inside the round
+    scan; under a sharded client axis the mask, the renormalized
+    weights, and the no-op-round logic must reproduce the unsharded run
+    exactly."""
+    mesh = make_mesh()
+    sharded = shard_setup(setup8, mesh)
+    kw = dict(lr=0.5, epoch=1, round=5, seed=0, lr_mode="constant",
+              participation=0.5)
+    res_u = FedAvg(setup8, **kw)
+    res_s = FedAvg(sharded, **kw)
+    np.testing.assert_allclose(res_s["train_loss"], res_u["train_loss"],
+                               atol=1e-5)
+    np.testing.assert_allclose(res_s["test_acc"], res_u["test_acc"],
+                               atol=1e-4)
