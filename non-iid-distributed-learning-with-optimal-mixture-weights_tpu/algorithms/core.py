@@ -76,12 +76,25 @@ def _print_round(t, train_loss, test_loss, test_acc):
 # call would recompile the whole program every time (and the first
 # "warmup" call would cache nothing).
 
+
+def _kernel_env() -> tuple:
+    """Snapshot of the kernel-selection env vars, used as a cache-key
+    component by every memoized trainer factory: kernel impls resolve
+    from these at trace time (fedcore.client.resolve_kernel_impl,
+    fedcore.aggregate.resolve_psolver_impl), so a factory compiled under
+    one setting must not be reused under another."""
+    import os
+
+    return (os.environ.get("FEDAMW_KERNEL", ""),
+            os.environ.get("FEDAMW_PSOLVER", ""))
+
+
 @functools.lru_cache(maxsize=64)
 def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                           epoch, batch_size, n_maxes, counts, rounds,
                           aggregation, lr_p, val_batch_size, n_val,
                           sequential, shard_factor, verbose=False,
-                          participation=1.0):
+                          participation=1.0, kernel_env=("", "")):
     """The full jitted training run for the round-based algorithms: one
     lax.scan over rounds. Memoized so repeated runs (sweeps, benchmarks,
     NNI trials) reuse the compiled program.
@@ -203,7 +216,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
 
 @functools.lru_cache(maxsize=64)
 def _cached_centralized_trainer(init_fn, apply_fn, task, D, num_classes,
-                                epoch, batch_size, n):
+                                epoch, batch_size, n, kernel_env=("", "")):
     """One jitted program for the Centralized baseline: init, the long
     pooled local run, eval — one dispatch (see _cached_round_trainer on
     why eager steps are expensive on remote-attached TPUs)."""
@@ -252,7 +265,7 @@ def Centralized(
     n = int(all_idx.shape[0])
     train = _cached_centralized_trainer(
         setup.model.init, setup.model.apply, setup.task, setup.D,
-        setup.num_classes, epoch, batch_size, n,
+        setup.num_classes, epoch, batch_size, n, _kernel_env(),
     )
     m = np.asarray(train(seed, setup.X, setup.y, all_idx,
                          setup.X_test, setup.y_test, float(lr)))
@@ -268,7 +281,7 @@ def Centralized(
 @functools.lru_cache(maxsize=64)
 def _cached_oneshot_local(init_fn, apply_fn, task, D, num_classes,
                           num_clients, epoch, batch_size, n_maxes, counts,
-                          sequential, shard_factor):
+                          sequential, shard_factor, kernel_env=("", "")):
     """Jitted one-shot local phase: init + every client training
     epoch*Round epochs from the same init (``tools.py:261-267``)."""
     round_fn = make_bucketed_round(apply_fn, task, epoch, batch_size,
@@ -303,7 +316,7 @@ def _cached_distributed_finish(apply_fn, task):
 
 @functools.lru_cache(maxsize=64)
 def _cached_oneshot_finish(apply_fn, task, rounds, lr_p, val_batch_size,
-                           n_val):
+                           n_val, kernel_env=("", "")):
     """FedAMW_OneShot mixture phase: ``rounds`` iterations of plain-SGD
     p-learning over cached logits, re-aggregating and evaluating after
     each (``tools.py:279-326``). Returns one flat
@@ -342,7 +355,7 @@ def _oneshot_local_phase(setup, epoch, batch_size, sequential, seed,
         setup.model.init, setup.model.apply, setup.task, setup.D,
         setup.num_classes, setup.num_clients, epoch, batch_size,
         setup.n_maxes, setup.bucket_counts, sequential,
-        setup.mesh_devices,
+        setup.mesh_devices, _kernel_env(),
     )
     return local(seed, setup.X, setup.y, idx_tup, mask_tup,
                  float(lr), float(mu), float(lam))
@@ -404,6 +417,7 @@ def FedAMW_OneShot(
     n_val = int(setup.X_val.shape[0])
     finish = _cached_oneshot_finish(
         setup.model.apply, setup.task, round, lr_p, val_batch_size, n_val,
+        _kernel_env(),
     )
     m = np.asarray(finish(
         seed, stacked, losses, setup.p_fixed, setup.sizes,
@@ -464,7 +478,7 @@ def _round_based(
         setup.num_classes, setup.num_clients, epoch, batch_size,
         setup.n_maxes, setup.bucket_counts, rounds,
         aggregation, lr_p, val_batch_size, n_val, sequential,
-        setup.mesh_devices, verbose, float(participation),
+        setup.mesh_devices, verbose, float(participation), _kernel_env(),
     )
 
     # Host-computed schedule from the Python-float lr: bit-identical to

@@ -102,3 +102,25 @@ def test_resolve_psolver_impl(monkeypatch):
     monkeypatch.delenv("FEDAMW_PSOLVER")
     # on CPU (the test env) auto resolves to xla
     assert resolve_psolver_impl("auto") == "xla"
+
+
+def test_fedamw_e2e_pallas_psolver_matches_xla(monkeypatch):
+    """End-to-end FedAMW with the env-selected Pallas p-solver must
+    match the XLA run (and the trainer cache must not leak programs
+    across env settings — the env snapshot is part of the cache key)."""
+    from fedamw_tpu.algorithms import FedAMW, prepare_setup
+    from fedamw_tpu.data import load_dataset
+
+    ds = load_dataset("digits", num_partitions=5, alpha=0.5)
+    setup = prepare_setup(ds, kernel_type="linear", seed=2,
+                          rng=np.random.RandomState(2))
+    kw = dict(lr=0.5, epoch=1, round=2, lambda_reg=1e-4, lr_p=1e-3,
+              seed=0, lr_mode="constant")
+    monkeypatch.setenv("FEDAMW_PSOLVER", "xla")
+    res_x = FedAMW(setup, **kw)
+    monkeypatch.setenv("FEDAMW_PSOLVER", "pallas_interpret")
+    res_p = FedAMW(setup, **kw)
+    np.testing.assert_allclose(res_p["test_acc"], res_x["test_acc"],
+                               atol=1e-3)
+    np.testing.assert_allclose(res_p["test_loss"], res_x["test_loss"],
+                               atol=1e-4)
