@@ -88,6 +88,53 @@ def bench_jax(ds, D, rounds, algorithm="FedAvg", epoch=2, batch_size=32,
     return J * rounds / dt, float(res["test_acc"][-1]), dt
 
 
+def bench_jax_best(ds, D, rounds, algorithm="FedAvg", **kw):
+    """Benchmark the XLA path, then (unless BENCH_NO_PALLAS is set) the
+    fused Pallas kernels, and keep the faster run.
+
+    The Pallas leg is best-effort: a Mosaic lowering failure on an
+    unvalidated platform must never cost the headline metric, and a
+    candidate only wins if its final accuracy matches the XLA run
+    (same seeds and shuffle streams -> same math, so a mismatch means
+    the kernel is wrong, not "different"). Returns
+    (updates/s, acc, seconds, impl_label).
+    """
+    xla = bench_jax(ds, D, rounds, algorithm=algorithm, **kw)
+    best = (*xla, "xla")
+    if os.environ.get("BENCH_NO_PALLAS"):
+        return best
+    import jax
+
+    from fedamw_tpu.fedcore.client import _TPU_BACKENDS
+
+    if jax.default_backend() not in _TPU_BACKENDS:
+        # off-TPU the client kernel silently falls back to XLA, so a
+        # "pallas" candidate would just re-time the XLA program (and
+        # mislabel the winner); the fused kernels are a TPU play only
+        return best
+    saved = {k: os.environ.get(k) for k in ("FEDAMW_KERNEL",
+                                            "FEDAMW_PSOLVER")}
+    try:
+        os.environ["FEDAMW_KERNEL"] = "pallas"
+        os.environ["FEDAMW_PSOLVER"] = "pallas"
+        cand = bench_jax(ds, D, rounds, algorithm=algorithm, **kw)
+        if abs(cand[1] - xla[1]) > 0.5:
+            print(f"# {algorithm} pallas leg acc {cand[1]:.2f} != xla "
+                  f"{xla[1]:.2f}; discarding", file=sys.stderr)
+        elif cand[0] > best[0]:
+            best = (*cand, "pallas")
+    except Exception as e:  # pragma: no cover - platform-dependent
+        print(f"# {algorithm} pallas leg unavailable: "
+              f"{type(e).__name__}", file=sys.stderr)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return best
+
+
 def bench_torch(ds, D, rounds, algorithm="FedAvg", epoch=2, batch_size=32,
                 lr=0.5, **kw):
     from fedamw_tpu.backends import torch_ref
@@ -107,6 +154,13 @@ def bench_torch(ds, D, rounds, algorithm="FedAvg", epoch=2, batch_size=32,
 
 
 def main():
+    if os.environ.get("JAX_PLATFORMS"):
+        # honor the env var even under this container's sitecustomize,
+        # which force-registers the axon TPU plugin (the config update
+        # must land before the first backend query)
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     num_clients = int(os.environ.get("BENCH_CLIENTS", "256"))
     rounds = int(os.environ.get("BENCH_ROUNDS", "20"))
     D = int(os.environ.get("BENCH_D", "2000"))
@@ -115,10 +169,10 @@ def main():
 
     ds = build_dataset(num_clients)
 
-    jax_ups, jax_acc, jax_dt = bench_jax(ds, D, rounds)
+    jax_ups, jax_acc, jax_dt, jax_impl = bench_jax_best(ds, D, rounds)
     torch_ups, torch_acc, torch_dt = bench_torch(ds, D, torch_rounds)
     print(
-        f"# FedAvg  jax: {jax_ups:.1f} updates/s ({rounds} rounds x "
+        f"# FedAvg  jax[{jax_impl}]: {jax_ups:.1f} updates/s ({rounds} rounds x "
         f"{num_clients} clients in {jax_dt:.2f}s, acc {jax_acc:.2f}) | "
         f"torch-cpu: {torch_ups:.1f} updates/s ({torch_rounds} rounds in "
         f"{torch_dt:.2f}s, acc {torch_acc:.2f})",
@@ -129,17 +183,18 @@ def main():
         "value": round(jax_ups, 2),
         "unit": "client-updates/s",
         "vs_baseline": round(jax_ups / torch_ups, 2),
+        "impl": jax_impl,
     }
 
     # The FedAMW leg must never cost us the headline metric (it is the
     # slowest leg: the torch p-solver is O(rounds^2) in wall-clock).
     try:
-        amw_ups, amw_acc, amw_dt = bench_jax(ds, D, rounds,
-                                             algorithm="FedAMW")
+        amw_ups, amw_acc, amw_dt, amw_impl = bench_jax_best(
+            ds, D, rounds, algorithm="FedAMW")
         amw_t_ups, amw_t_acc, amw_t_dt = bench_torch(
             ds, D, amw_torch_rounds, algorithm="FedAMW")
         print(
-            f"# FedAMW  jax: {amw_ups:.1f} updates/s ({rounds} rounds in "
+            f"# FedAMW  jax[{amw_impl}]: {amw_ups:.1f} updates/s ({rounds} rounds in "
             f"{amw_dt:.2f}s, acc {amw_acc:.2f}) | torch-cpu: "
             f"{amw_t_ups:.1f} updates/s ({amw_torch_rounds} rounds in "
             f"{amw_t_dt:.2f}s, acc {amw_t_acc:.2f})",
@@ -150,6 +205,7 @@ def main():
             "value": round(amw_ups, 2),
             "unit": "client-updates/s",
             "vs_baseline": round(amw_ups / amw_t_ups, 2),
+            "impl": amw_impl,
         }))
     except Exception as e:  # pragma: no cover - defensive
         print(f"# FedAMW leg failed: {e!r}", file=sys.stderr)
