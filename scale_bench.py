@@ -138,6 +138,13 @@ def rcv1_4096(rounds, buckets):
 
 
 def main():
+    if os.environ.get("JAX_PLATFORMS"):
+        # honor the env var under the container's sitecustomize (which
+        # force-registers the axon TPU plugin): the config update must
+        # land before the first backend query
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     rounds = int(os.environ.get("SCALE_ROUNDS", "10"))
     buckets = int(os.environ.get("SCALE_BUCKETS", "64"))
     configs = os.environ.get("SCALE_CONFIGS", "covtype1024,rcv14096")
