@@ -443,6 +443,7 @@ def _round_based(
     verbose=False,
     return_state=False,
     participation=1.0,
+    analyze_memory=False,
 ):
     """Common skeleton of FedAvg/FedProx/FedNova/FedAMW: scan over rounds
     of {local updates -> aggregate -> eval} (``tools.py:337-352``).
@@ -488,17 +489,29 @@ def _round_based(
     lrs = lr_schedule_array(lr, rounds, lr_mode)
 
     if aggregation == "learned":
-        metrics, fparams, fp = train(
-            seed, setup.X, setup.y, idx_tup, mask_tup,
-            setup.X_val, setup.y_val, setup.X_test, setup.y_test,
-            lrs, setup.p_fixed, setup.sizes, float(mu), float(lam),
-        )
+        args = (seed, setup.X, setup.y, idx_tup, mask_tup,
+                setup.X_val, setup.y_val, setup.X_test, setup.y_test,
+                lrs, setup.p_fixed, setup.sizes, float(mu), float(lam))
     else:
-        metrics, fparams, fp = train(
-            seed, setup.X, setup.y, idx_tup, mask_tup,
-            setup.X_test, setup.y_test, lrs,
-            setup.p_fixed, setup.sizes, float(mu), float(lam),
-        )
+        args = (seed, setup.X, setup.y, idx_tup, mask_tup,
+                setup.X_test, setup.y_test, lrs,
+                setup.p_fixed, setup.sizes, float(mu), float(lam))
+
+    if analyze_memory:
+        # AOT device-memory report for the WHOLE fused training program
+        # (the axon remote runtime exposes no live memory_stats(), so
+        # this is how HBM footprints get measured; BASELINE.md).
+        ma = train.lower(*args).compile().memory_analysis()
+        return {
+            k: int(getattr(ma, k))
+            for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                      "temp_size_in_bytes", "alias_size_in_bytes",
+                      "peak_memory_in_bytes",
+                      "generated_code_size_in_bytes")
+            if getattr(ma, k, None) is not None
+        }
+
+    metrics, fparams, fp = train(*args)
 
     metrics = np.asarray(metrics)
     out = result_tuple(metrics[0], metrics[1], metrics[2])
@@ -526,6 +539,7 @@ def FedAvg(
     verbose=False,
     return_state=False,
     participation=1.0,
+    analyze_memory=False,
     **_,
 ):
     """Standard FedAvg (``tools.py:329-353``)."""
@@ -535,6 +549,7 @@ def FedAvg(
         seed=seed, lr_mode=lr_mode, sequential=sequential,
         verbose=verbose, return_state=return_state,
         participation=participation,
+        analyze_memory=analyze_memory,
     )
 
 
@@ -554,6 +569,7 @@ def FedProx(
     verbose=False,
     return_state=False,
     participation=1.0,
+    analyze_memory=False,
     **_,
 ):
     """FedAvg skeleton + proximal term (``tools.py:356-380``)."""
@@ -563,6 +579,7 @@ def FedProx(
         seed=seed, lr_mode=lr_mode, sequential=sequential,
         verbose=verbose, return_state=return_state,
         participation=participation,
+        analyze_memory=analyze_memory,
     )
 
 
@@ -582,6 +599,7 @@ def FedNova(
     verbose=False,
     return_state=False,
     participation=1.0,
+    analyze_memory=False,
     **_,
 ):
     """Normalized averaging (``tools.py:383-410``)."""
@@ -591,6 +609,7 @@ def FedNova(
         seed=seed, lr_mode=lr_mode, sequential=sequential,
         verbose=verbose, return_state=return_state,
         participation=participation,
+        analyze_memory=analyze_memory,
     )
 
 
@@ -612,6 +631,7 @@ def FedAMW(
     verbose=False,
     return_state=False,
     participation=1.0,
+    analyze_memory=False,
     **_,
 ):
     """The paper's algorithm (``tools.py:413-463``): ridge-regularized
@@ -631,4 +651,5 @@ def FedAMW(
         lr_p=lr_p, val_batch_size=val_batch_size,
         seed=seed, lr_mode=lr_mode, sequential=sequential,
         verbose=verbose, return_state=return_state,
+        analyze_memory=analyze_memory,
     )

@@ -73,6 +73,19 @@ def run_config(name, ds, model, kernel_type, D, num_clients, rounds,
             "rounds": rounds,
             "buckets": buckets,
         }
+        if os.environ.get("SCALE_MEMORY", "1") != "0":
+            # AOT compile report: the axon runtime has no live
+            # memory_stats(), so the compiler's own buffer assignment is
+            # the HBM footprint source of truth (BASELINE.md gap)
+            ma = fn(setup, lr=lr, epoch=epoch, batch_size=batch_size,
+                    round=rounds, seed=0, lr_mode="constant",
+                    analyze_memory=True)
+            rec["hbm_compiled_peak_gb"] = round(
+                ma.get("peak_memory_in_bytes", 0) / 1e9, 3)
+            rec["hbm_args_gb"] = round(
+                ma.get("argument_size_in_bytes", 0) / 1e9, 3)
+            rec["hbm_temp_gb"] = round(
+                ma.get("temp_size_in_bytes", 0) / 1e9, 3)
         print(json.dumps(rec), flush=True)
         recs.append(rec)
     return recs

@@ -138,3 +138,26 @@ def test_regression_task():
     assert res["test_loss"].shape == (3,)
     assert np.all(np.isfinite(res["test_loss"]))
     assert res["test_loss"][-1] < res["test_loss"][0]
+
+
+def test_analyze_memory_reports_compiled_footprint():
+    """analyze_memory=True returns the AOT compiler's device-memory
+    report for the whole fused training program instead of running it
+    (the axon runtime exposes no live memory_stats(); BASELINE.md)."""
+    import numpy as np
+
+    from fedamw_tpu.algorithms import FedAvg, prepare_setup
+    from fedamw_tpu.data import load_dataset
+
+    ds = load_dataset("digits", num_partitions=4, alpha=0.5)
+    setup = prepare_setup(ds, kernel_type="linear", seed=0,
+                          rng=np.random.RandomState(0))
+    ma = FedAvg(setup, lr=0.5, epoch=1, round=2, seed=0,
+                lr_mode="constant", analyze_memory=True)
+    assert ma["argument_size_in_bytes"] > 0
+    # arguments must dominate: the resident feature matrix is the big
+    # buffer, and temp must stay the same order (no accidental
+    # per-round duplication of X inside the scan)
+    X_bytes = setup.X.size * setup.X.dtype.itemsize
+    assert ma["argument_size_in_bytes"] >= X_bytes
+    assert ma["temp_size_in_bytes"] < 50 * X_bytes
