@@ -1,0 +1,102 @@
+"""Standalone hyperparameter sweep — the NNI-free twin of config.yml.
+
+NNI is not installed on this box, so this driver replays the reference
+tuning setup (`/root/reference/config.yml`: TPE over lr_p x lambda_reg,
+trial = `tune.py`) without the daemon: it samples trials from the SAME
+search grid, calls ``tune.main`` in-process (sharing the jit cache
+across trials — the round-scan program recompiles only when a
+jit-static knob like lr_p changes), and writes a ranked TUNING.md.
+With NNI installed, `nnictl create --config config.yml` remains the
+full TPE path; this script is the zero-dependency fallback and the
+generator of the committed tuning artifact.
+
+Usage: python sweep.py [--dataset digits] [--trials 12] [--round 50]
+                       [--seed 7] [--out TUNING.md]
+"""
+
+import argparse
+import os
+import time
+
+
+# the reference search space, config.yml:12-17 (verbatim grids)
+LR_P_GRID = [0.5, 0.1, 0.01, 0.005, 0.001, 0.0005, 0.0001,
+             0.00005, 0.00001, 0.000005, 0.000001]
+LAMBDA_REG_GRID = [0.1, 0.01, 0.005, 0.001, 0.0005, 0.0001,
+                   0.00005, 0.00001, 0.000005, 0.0000001]
+
+
+def run_sweep(dataset, trials, rounds, seed, backend="jax"):
+    import numpy as np
+
+    import tune
+
+    rng = np.random.RandomState(seed)
+    grid = [(lp, lam) for lp in LR_P_GRID for lam in LAMBDA_REG_GRID]
+    picks = [grid[i] for i in rng.choice(len(grid), size=min(trials, len(grid)),
+                                         replace=False)]
+    results = []
+    for i, (lr_p, lam) in enumerate(picks):
+        params = vars(tune.get_params())
+        params.update(dataset=dataset, lr_p=lr_p, lambda_reg=lam,
+                      round=rounds, backend=backend)
+        t0 = time.perf_counter()
+        acc = tune.main(params)
+        dt = time.perf_counter() - t0
+        results.append({"lr_p": lr_p, "lambda_reg": lam,
+                        "acc": acc, "wall_s": dt})
+        print(f"[trial {i + 1}/{len(picks)}] lr_p={lr_p} lambda_reg={lam} "
+              f"-> acc {acc:.2f} ({dt:.1f}s)", flush=True)
+    return sorted(results, key=lambda r: -r["acc"])
+
+
+def write_report(results, dataset, rounds, seed, out):
+    lines = [
+        "# TUNING — FedAMW hyperparameter sweep (standalone)",
+        "",
+        f"`sweep.py --dataset {dataset} --trials {len(results)} "
+        f"--round {rounds} --seed {seed}` — random search over the",
+        "reference TPE grid (`/root/reference/config.yml:12-17`; NNI is",
+        "not installed here, so this is the zero-dependency twin of the",
+        "`nnictl` flow — `tune.py` is the trial entry in both). 50",
+        "clients, Dirichlet alpha=0.01, D=2000 RFF, the registry's",
+        "remaining hyperparameters.",
+        "",
+        "| rank | lr_p | lambda_reg | final acc | trial wall (s) |",
+        "|---|---|---|---|---|",
+    ]
+    for i, r in enumerate(results):
+        lines.append(f"| {i + 1} | {r['lr_p']} | {r['lambda_reg']} | "
+                     f"{r['acc']:.2f} | {r['wall_s']:.1f} |")
+    lines += [
+        "",
+        "Best-found settings feed the `digits` registry block",
+        "(`config.py`); the reference's own per-dataset blocks were",
+        "produced the same way at larger trial counts.",
+        "",
+    ]
+    with open(out, "w") as f:
+        f.write("\n".join(lines))
+    print(f"report -> {out}")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dataset", type=str, default="digits")
+    ap.add_argument("--trials", type=int, default=12)
+    ap.add_argument("--round", type=int, default=50)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--backend", type=str, default="jax")
+    ap.add_argument("--out", type=str, default="TUNING.md")
+    args = ap.parse_args()
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    results = run_sweep(args.dataset, args.trials, args.round, args.seed,
+                        args.backend)
+    write_report(results, args.dataset, args.round, args.seed, args.out)
+
+
+if __name__ == "__main__":
+    main()
