@@ -10,12 +10,22 @@ fake-mesh trick for exercising multi-chip sharding without hardware.
 
 import os
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
-os.environ["JAX_PLATFORMS"] = "cpu"
+if os.environ.get("FEDAMW_TEST_PLATFORM", "cpu") == "cpu":
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
-import jax  # noqa: E402
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", False)
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", False)
+else:
+    # FEDAMW_TEST_PLATFORM=tpu: leave the real backend in place so the
+    # hardware-validation tests (tests/test_pallas_tpu.py) run against
+    # the attached chip; the mesh/virtual-device tests will skip or
+    # fail fast there — run them in the default CPU mode.
+    import jax
+
+    jax.config.update("jax_enable_x64", False)

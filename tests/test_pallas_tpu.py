@@ -1,0 +1,94 @@
+"""Hardware validation for the fused Pallas kernels.
+
+These tests compile and run the Mosaic kernels on a REAL TPU backend
+and pin them against the XLA implementations. They are skipped in the
+default test environment (conftest.py forces an 8-device virtual CPU
+mesh); run them on a TPU-attached box with:
+
+    FEDAMW_TEST_PLATFORM=tpu python -m pytest tests/test_pallas_tpu.py -q
+
+Interpret-mode numerical parity lives in test_pallas_kernel.py /
+test_pallas_psolver.py; this file answers the remaining question —
+"does Mosaic actually lower and produce the same numbers on hardware?"
+(Round-2 history: the epoch kernel passed interpret tests but failed to
+lower on a v5e until the block layouts and reductions were reshaped;
+see PERFORMANCE.md.)
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from fedamw_tpu.fedcore.client import _TPU_BACKENDS
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() not in _TPU_BACKENDS,
+    reason="needs a real TPU backend (FEDAMW_TEST_PLATFORM=tpu)",
+)
+
+
+def test_epoch_kernel_lowers_and_matches_xla():
+    import jax.numpy as jnp
+
+    from fedamw_tpu.fedcore.pallas_kernel import make_pallas_epoch
+
+    C, D, B, S = 2, 2000, 32, 7
+    rng = np.random.RandomState(0)
+    epoch = make_pallas_epoch("classification", C, D, B, S)
+    w0 = jnp.asarray(rng.randn(C, D).astype(np.float32) * 0.01)
+    Xe = jnp.asarray(rng.randn(S, B, D).astype(np.float32))
+    ye = jnp.asarray(rng.randint(0, C, (S, B)).astype(np.int32))
+    bv = jnp.ones((S, B), jnp.float32)
+    bv = bv.at[-1, 20:].set(0.0)  # partial last batch
+    scal = jnp.asarray([0.1, 0.01, 0.001], jnp.float32)
+    w, met = jax.jit(epoch)(w0, w0, Xe, ye, bv, scal)
+    w, met = np.asarray(w), np.asarray(met)
+
+    ref = make_pallas_epoch("classification", C, D, B, S, interpret=True)
+    w_i, met_i = jax.jit(ref)(w0, w0, Xe, ye, bv, scal)
+    np.testing.assert_allclose(w, np.asarray(w_i), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(met, np.asarray(met_i), rtol=1e-4)
+
+
+@pytest.mark.parametrize("task,C", [("classification", 2),
+                                    ("regression", 1)])
+def test_psolver_kernel_lowers_and_matches_xla(task, C):
+    from fedamw_tpu.fedcore.aggregate import make_p_solver
+
+    n_val, J, B = 253, 64, 16
+    rng = np.random.RandomState(1)
+    import jax.numpy as jnp
+
+    logits = jnp.asarray(rng.randn(n_val, J, C).astype(np.float32))
+    if task == "classification":
+        y = jnp.asarray(rng.randint(0, C, n_val).astype(np.int32))
+    else:
+        y = jnp.asarray(rng.randn(n_val).astype(np.float32))
+    p0 = jnp.ones(J, jnp.float32) / J
+    key = jax.random.PRNGKey(3)
+
+    sx, ix = make_p_solver(task, n_val, B, 5e-3, 0.9, kernel_impl="xla")
+    sp, ip = make_p_solver(task, n_val, B, 5e-3, 0.9, kernel_impl="pallas")
+    px = np.asarray(sx(logits, y, p0, ix(p0), key, 3)[0])
+    pp = np.asarray(sp(logits, y, p0, ip(p0), key, 3)[0])
+    np.testing.assert_allclose(pp, px, rtol=1e-4, atol=1e-6)
+
+
+def test_fedamw_e2e_with_pallas_kernels(monkeypatch):
+    """Full FedAMW run with both fused kernels selected via env."""
+    from fedamw_tpu.algorithms import FedAMW, prepare_setup
+    from fedamw_tpu.data import load_dataset
+
+    ds = load_dataset("digits", num_partitions=8, alpha=0.5)
+    setup = prepare_setup(ds, kernel_type="linear", seed=4,
+                          rng=np.random.RandomState(4))
+    kw = dict(lr=0.5, epoch=1, round=3, lambda_reg=1e-4, lr_p=1e-3,
+              seed=0, lr_mode="constant")
+    monkeypatch.setenv("FEDAMW_KERNEL", "xla")
+    monkeypatch.setenv("FEDAMW_PSOLVER", "xla")
+    res_x = FedAMW(setup, **kw)
+    monkeypatch.setenv("FEDAMW_KERNEL", "pallas")
+    monkeypatch.setenv("FEDAMW_PSOLVER", "pallas")
+    res_p = FedAMW(setup, **kw)
+    np.testing.assert_allclose(np.asarray(res_p["test_acc"]),
+                               np.asarray(res_x["test_acc"]), atol=0.5)
