@@ -94,7 +94,11 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                           epoch, batch_size, n_maxes, counts, rounds,
                           aggregation, lr_p, val_batch_size, n_val,
                           sequential, shard_factor, verbose=False,
-                          participation=1.0, kernel_env=("", "")):
+                          participation=1.0, kernel_env=("", ""),
+                          start_round=0, stop_round=None):
+    # stop_round: required resolved int (the sole caller, _round_based,
+    # always passes it; no None-resolution here so the cache cannot hold
+    # duplicate programs for equivalent keys)
     """The full jitted training run for the round-based algorithms: one
     lax.scan over rounds. Memoized so repeated runs (sweeps, benchmarks,
     NNI trials) reuse the compiled program.
@@ -111,9 +115,15 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                                    n_maxes, counts, sequential=sequential,
                                    shard_factor=shard_factor)
     evaluate = make_evaluator(apply_fn, task)
+    # Interruptible runs: the scan covers [start_round, stop_round) of
+    # the full `rounds` horizon, but every per-round stream (client
+    # shuffle keys, p-solver keys, participation keys, the LR schedule)
+    # is generated for the FULL horizon and sliced — so prefix +
+    # checkpoint + resume reproduces the uninterrupted run exactly.
+    stop = stop_round
 
     def prologue(seed):
-        keys = _keys(seed, rounds, num_clients)
+        keys = _keys(seed, rounds, num_clients)[start_round:stop]
         params0 = _derive_params(init_fn, seed, D, num_classes)
         return keys, params0
 
@@ -132,9 +142,13 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
 
         @jax.jit
         def train(seed, X, y, idx, mask, X_val, y_val,
-                  X_test, y_test, lrs, p0, sizes, mu, lam):
+                  X_test, y_test, lrs, p0, sizes, mu, lam,
+                  params0=None):
             keys, params = prologue(seed)
-            pkeys = jax.random.split(jax.random.PRNGKey(seed + 1), rounds)
+            if params0 is not None:  # resume / warm start
+                params = params0
+            pkeys = jax.random.split(
+                jax.random.PRNGKey(seed + 1), rounds)[start_round:stop]
             p, opt_state = p0, init_opt(p0)
             # inert padded clients (mesh-even packing) never earn weight
             client_valid = (sizes > 0).astype(jnp.float32)
@@ -158,7 +172,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
 
             (params, p, opt_state), metrics = jax.lax.scan(
                 body, (params, p, opt_state),
-                (jnp.arange(rounds), lrs, keys, pkeys),
+                (jnp.arange(start_round, stop), lrs, keys, pkeys),
             )
             return jnp.stack(metrics), params, p
 
@@ -166,8 +180,10 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
 
     @jax.jit
     def train(seed, X, y, idx, mask, X_test, y_test, lrs,
-              p_fixed, sizes, mu, lam):
+              p_fixed, sizes, mu, lam, params0=None):
         keys, params = prologue(seed)
+        if params0 is not None:  # resume / warm start
+            params = params0
         if aggregation == "nova":
             agg_w = fednova_effective_weights(sizes, p_fixed, epoch,
                                               batch_size)
@@ -177,7 +193,8 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
         # client every round, tools.py:340): per-round Bernoulli mask
         # over the real (non-padded) clients, weights renormalized over
         # the participating subset; an all-absent round is a no-op.
-        part_keys = jax.random.split(jax.random.PRNGKey(seed + 2), rounds)
+        part_keys = jax.random.split(
+            jax.random.PRNGKey(seed + 2), rounds)[start_round:stop]
         valid = (sizes > 0).astype(jnp.float32)
 
         def body(params, inp):
@@ -207,7 +224,8 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
             return params, (train_loss_t, tl, ta)
 
         params, metrics = jax.lax.scan(
-            body, params, (jnp.arange(rounds), lrs, keys, part_keys)
+            body, params, (jnp.arange(start_round, stop), lrs, keys,
+                           part_keys)
         )
         return jnp.stack(metrics), params, p_fixed
 
@@ -444,6 +462,9 @@ def _round_based(
     return_state=False,
     participation=1.0,
     analyze_memory=False,
+    start_round=0,
+    stop_round=None,
+    resume_from=None,
 ):
     """Common skeleton of FedAvg/FedProx/FedNova/FedAMW: scan over rounds
     of {local updates -> aggregate -> eval} (``tools.py:337-352``).
@@ -458,6 +479,15 @@ def _round_based(
     if not 0.0 < participation <= 1.0:
         raise ValueError(f"participation must be in (0, 1], got "
                          f"{participation}")
+    stop = rounds if stop_round is None else int(stop_round)
+    if not 0 <= start_round < stop <= rounds:
+        raise ValueError(f"need 0 <= start_round < stop_round <= round, "
+                         f"got start={start_round} stop={stop} "
+                         f"round={rounds}")
+    if start_round > 0 and resume_from is None:
+        raise ValueError("start_round > 0 requires resume_from (a dict "
+                         "with 'params' — utils.checkpoint."
+                         "load_checkpoint's layout)")
     if sequential and participation < 1.0:
         # The sequential-compat chain (client i+1 starts from client i's
         # weights, reference tools.py:341) has no defined semantics for
@@ -480,22 +510,33 @@ def _round_based(
         setup.n_maxes, setup.bucket_counts, rounds,
         aggregation, lr_p, val_batch_size, n_val, sequential,
         setup.mesh_devices, verbose, float(participation), _kernel_env(),
+        int(start_round), stop,
     )
 
     # Host-computed schedule from the Python-float lr: bit-identical to
     # the torch backend's lr_schedule_array path (an in-graph f32
     # rescale of unit factors can differ by 1 ulp); transferred as part
     # of the one dispatch, not as a separate eager op.
-    lrs = lr_schedule_array(lr, rounds, lr_mode)
+    lrs = lr_schedule_array(lr, rounds, lr_mode)[start_round:stop]
+
+    params0 = None
+    p0 = setup.p_fixed
+    if resume_from is not None:
+        params0 = jax.tree.map(jnp.asarray, resume_from["params"])
+        if aggregation == "learned" and resume_from.get("p") is not None:
+            # the learned mixture weights continue from the checkpoint;
+            # the p-optimizer's momentum buffer restarts at zero (the
+            # checkpoint layout does not carry it)
+            p0 = jnp.asarray(resume_from["p"])
 
     if aggregation == "learned":
         args = (seed, setup.X, setup.y, idx_tup, mask_tup,
                 setup.X_val, setup.y_val, setup.X_test, setup.y_test,
-                lrs, setup.p_fixed, setup.sizes, float(mu), float(lam))
+                lrs, p0, setup.sizes, float(mu), float(lam), params0)
     else:
         args = (seed, setup.X, setup.y, idx_tup, mask_tup,
                 setup.X_test, setup.y_test, lrs,
-                setup.p_fixed, setup.sizes, float(mu), float(lam))
+                p0, setup.sizes, float(mu), float(lam), params0)
 
     if analyze_memory:
         # AOT device-memory report for the WHOLE fused training program
@@ -540,6 +581,9 @@ def FedAvg(
     return_state=False,
     participation=1.0,
     analyze_memory=False,
+    start_round=0,
+    stop_round=None,
+    resume_from=None,
     **_,
 ):
     """Standard FedAvg (``tools.py:329-353``)."""
@@ -550,6 +594,8 @@ def FedAvg(
         verbose=verbose, return_state=return_state,
         participation=participation,
         analyze_memory=analyze_memory,
+        start_round=start_round, stop_round=stop_round,
+        resume_from=resume_from,
     )
 
 
@@ -570,6 +616,9 @@ def FedProx(
     return_state=False,
     participation=1.0,
     analyze_memory=False,
+    start_round=0,
+    stop_round=None,
+    resume_from=None,
     **_,
 ):
     """FedAvg skeleton + proximal term (``tools.py:356-380``)."""
@@ -580,6 +629,8 @@ def FedProx(
         verbose=verbose, return_state=return_state,
         participation=participation,
         analyze_memory=analyze_memory,
+        start_round=start_round, stop_round=stop_round,
+        resume_from=resume_from,
     )
 
 
@@ -600,6 +651,9 @@ def FedNova(
     return_state=False,
     participation=1.0,
     analyze_memory=False,
+    start_round=0,
+    stop_round=None,
+    resume_from=None,
     **_,
 ):
     """Normalized averaging (``tools.py:383-410``)."""
@@ -610,6 +664,8 @@ def FedNova(
         verbose=verbose, return_state=return_state,
         participation=participation,
         analyze_memory=analyze_memory,
+        start_round=start_round, stop_round=stop_round,
+        resume_from=resume_from,
     )
 
 
@@ -632,6 +688,9 @@ def FedAMW(
     return_state=False,
     participation=1.0,
     analyze_memory=False,
+    start_round=0,
+    stop_round=None,
+    resume_from=None,
     **_,
 ):
     """The paper's algorithm (``tools.py:413-463``): ridge-regularized
@@ -652,4 +711,6 @@ def FedAMW(
         seed=seed, lr_mode=lr_mode, sequential=sequential,
         verbose=verbose, return_state=return_state,
         analyze_memory=analyze_memory,
+        start_round=start_round, stop_round=stop_round,
+        resume_from=resume_from,
     )

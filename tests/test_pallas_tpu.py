@@ -27,7 +27,7 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-def test_epoch_kernel_lowers_and_matches_xla():
+def test_epoch_kernel_lowers_and_matches_interpret():
     import jax.numpy as jnp
 
     from fedamw_tpu.fedcore.pallas_kernel import make_pallas_epoch
