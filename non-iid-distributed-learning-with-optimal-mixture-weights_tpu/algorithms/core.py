@@ -95,7 +95,8 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                           aggregation, lr_p, val_batch_size, n_val,
                           sequential, shard_factor, verbose=False,
                           participation=1.0, kernel_env=("", ""),
-                          start_round=0, stop_round=None):
+                          start_round=0, stop_round=None,
+                          server_opt="none", server_lr=1.0):
     # stop_round: required resolved int (the sole caller, _round_based,
     # always passes it; no None-resolution here so the cache cannot hold
     # duplicate programs for equivalent keys)
@@ -178,6 +179,27 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
 
         return train
 
+    # FedOpt (Reddi et al. 2021, arXiv:2003.00295) server optimizer —
+    # an extension; the reference always overwrites the global model
+    # with the weighted average (tools.py:350). The aggregate step
+    # becomes one optax update on the pseudo-gradient
+    # g_t = w_t - aggregate_t ("none" keeps the reference rule; "sgd"
+    # with server_lr=1.0 is numerically the same update).
+    if server_opt == "none":
+        server_tx = None
+    elif server_opt == "sgd":
+        import optax
+
+        server_tx = optax.sgd(server_lr)
+    elif server_opt == "adam":
+        import optax
+
+        # FedAdam hyperparameters per the FedOpt paper's defaults
+        server_tx = optax.adam(server_lr, b1=0.9, b2=0.99, eps=1e-3)
+    else:
+        raise ValueError(f"server_opt must be none|sgd|adam, got "
+                         f"{server_opt!r}")
+
     @jax.jit
     def train(seed, X, y, idx, mask, X_test, y_test, lrs,
               p_fixed, sizes, mu, lam, params0=None):
@@ -197,7 +219,8 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
             jax.random.PRNGKey(seed + 2), rounds)[start_round:stop]
         valid = (sizes > 0).astype(jnp.float32)
 
-        def body(params, inp):
+        def body(carry, inp):
+            params, opt_state = carry
             t, lr_t, keys_t, part_key_t = inp
             stacked, losses, _ = round_fn(
                 params, X, y, idx, mask, keys_t, lr_t, mu, lam,
@@ -209,23 +232,37 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 ).astype(jnp.float32)
                 w_t = participation_weights(agg_w, part)
                 loss_w = participation_weights(p_fixed, part)
-                new_params = weighted_average(stacked, w_t)
+                agg = weighted_average(stacked, w_t)
                 any_part = jnp.sum(part) > 0
-                params = jax.tree.map(
+                # an all-absent round must also be a no-op for the
+                # server optimizer: keep agg == params (zero pseudo-
+                # gradient) rather than averaging with zero weights
+                agg = jax.tree.map(
                     lambda new, old: jnp.where(any_part, new, old),
-                    new_params, params,
+                    agg, params,
                 )
                 train_loss_t = jnp.sum(loss_w * losses)
             else:
                 train_loss_t = jnp.sum(p_fixed * losses)
-                params = weighted_average(stacked, agg_w)
+                agg = weighted_average(stacked, agg_w)
+            if server_tx is None:
+                params = agg
+            else:
+                pseudo_grad = jax.tree.map(jnp.subtract, params, agg)
+                updates, opt_state = server_tx.update(pseudo_grad,
+                                                      opt_state, params)
+                import optax
+
+                params = optax.apply_updates(params, updates)
             tl, ta = evaluate(params, X_test, y_test)
             stream_metrics(t, train_loss_t, tl, ta)
-            return params, (train_loss_t, tl, ta)
+            return (params, opt_state), (train_loss_t, tl, ta)
 
-        params, metrics = jax.lax.scan(
-            body, params, (jnp.arange(start_round, stop), lrs, keys,
-                           part_keys)
+        opt_state0 = (() if server_tx is None
+                      else server_tx.init(params))
+        (params, _), metrics = jax.lax.scan(
+            body, (params, opt_state0),
+            (jnp.arange(start_round, stop), lrs, keys, part_keys)
         )
         return jnp.stack(metrics), params, p_fixed
 
@@ -465,6 +502,8 @@ def _round_based(
     start_round=0,
     stop_round=None,
     resume_from=None,
+    server_opt="none",
+    server_lr=1.0,
 ):
     """Common skeleton of FedAvg/FedProx/FedNova/FedAMW: scan over rounds
     of {local updates -> aggregate -> eval} (``tools.py:337-352``).
@@ -479,6 +518,11 @@ def _round_based(
     if not 0.0 < participation <= 1.0:
         raise ValueError(f"participation must be in (0, 1], got "
                          f"{participation}")
+    if aggregation == "learned" and server_opt != "none":
+        raise ValueError(
+            "FedAMW aggregates with LEARNED mixture weights; composing "
+            "a FedOpt server optimizer on top is undefined — "
+            "server_opt applies to FedAvg/FedProx/FedNova")
     stop = rounds if stop_round is None else int(stop_round)
     if not 0 <= start_round < stop <= rounds:
         raise ValueError(f"need 0 <= start_round < stop_round <= round, "
@@ -510,7 +554,7 @@ def _round_based(
         setup.n_maxes, setup.bucket_counts, rounds,
         aggregation, lr_p, val_batch_size, n_val, sequential,
         setup.mesh_devices, verbose, float(participation), _kernel_env(),
-        int(start_round), stop,
+        int(start_round), stop, server_opt, float(server_lr),
     )
 
     # Host-computed schedule from the Python-float lr: bit-identical to
@@ -584,6 +628,8 @@ def FedAvg(
     start_round=0,
     stop_round=None,
     resume_from=None,
+    server_opt="none",
+    server_lr=1.0,
     **_,
 ):
     """Standard FedAvg (``tools.py:329-353``)."""
@@ -596,6 +642,7 @@ def FedAvg(
         analyze_memory=analyze_memory,
         start_round=start_round, stop_round=stop_round,
         resume_from=resume_from,
+        server_opt=server_opt, server_lr=server_lr,
     )
 
 
@@ -619,6 +666,8 @@ def FedProx(
     start_round=0,
     stop_round=None,
     resume_from=None,
+    server_opt="none",
+    server_lr=1.0,
     **_,
 ):
     """FedAvg skeleton + proximal term (``tools.py:356-380``)."""
@@ -631,6 +680,7 @@ def FedProx(
         analyze_memory=analyze_memory,
         start_round=start_round, stop_round=stop_round,
         resume_from=resume_from,
+        server_opt=server_opt, server_lr=server_lr,
     )
 
 
@@ -654,6 +704,8 @@ def FedNova(
     start_round=0,
     stop_round=None,
     resume_from=None,
+    server_opt="none",
+    server_lr=1.0,
     **_,
 ):
     """Normalized averaging (``tools.py:383-410``)."""
@@ -666,6 +718,7 @@ def FedNova(
         analyze_memory=analyze_memory,
         start_round=start_round, stop_round=stop_round,
         resume_from=resume_from,
+        server_opt=server_opt, server_lr=server_lr,
     )
 
 
@@ -691,6 +744,8 @@ def FedAMW(
     start_round=0,
     stop_round=None,
     resume_from=None,
+    server_opt="none",
+    server_lr=1.0,
     **_,
 ):
     """The paper's algorithm (``tools.py:413-463``): ridge-regularized
@@ -713,4 +768,5 @@ def FedAMW(
         analyze_memory=analyze_memory,
         start_round=start_round, stop_round=stop_round,
         resume_from=resume_from,
+        server_opt=server_opt, server_lr=server_lr,
     )

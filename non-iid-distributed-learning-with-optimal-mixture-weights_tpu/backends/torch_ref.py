@@ -308,7 +308,16 @@ def _participation_weights(agg_w, part):
 
 def _rounds(setup, aggregation, lr, epoch, batch_size, rounds, mu, lam,
             lr_p=5e-5, val_batch_size=16, seed=0, lr_mode="reference",
-            sequential=False, verbose=False, participation=1.0):
+            sequential=False, verbose=False, participation=1.0,
+            server_opt="none", server_lr=1.0):
+    if server_opt not in ("none", "sgd", "adam"):
+        raise ValueError(f"server_opt must be none|sgd|adam, got "
+                         f"{server_opt!r}")
+    if aggregation == "learned" and server_opt != "none":
+        raise ValueError(
+            "FedAMW aggregates with LEARNED mixture weights; composing "
+            "a FedOpt server optimizer on top is undefined — "
+            "server_opt applies to FedAvg/FedProx/FedNova")
     if not 0.0 < participation <= 1.0:
         raise ValueError(f"participation must be in (0, 1], got "
                          f"{participation}")
@@ -333,6 +342,11 @@ def _rounds(setup, aggregation, lr, epoch, batch_size, rounds, mu, lam,
     else:
         agg_w = p
     buf = torch.zeros_like(p)
+    # FedOpt server-optimizer state (extension; mirrors the JAX
+    # backend's optax.adam(b1=0.9, b2=0.99, eps=1e-3) formulas exactly,
+    # including bias correction)
+    srv_m = torch.zeros_like(w)
+    srv_v = torch.zeros_like(w)
     train_loss = np.zeros(rounds)
     test_loss = np.zeros(rounds)
     test_acc = np.zeros(rounds)
@@ -359,18 +373,32 @@ def _rounds(setup, aggregation, lr, epoch, batch_size, rounds, mu, lam,
             train_loss[t] = float(
                 (_participation_weights(p, part) * losses).sum())
             if float((agg_w * part).sum()) > 0:
-                w = _weighted_average(stacked,
-                                      _participation_weights(agg_w, part))
+                agg = _weighted_average(stacked,
+                                        _participation_weights(agg_w, part))
+            else:
+                agg = w  # all-absent round: zero pseudo-gradient
         elif aggregation == "learned":
             train_loss[t] = float((p * losses).sum())
             with torch.no_grad():
                 logits = torch.einsum("jcd,nd->njc", stacked, setup.X_val)
             p, buf = _solve_p(logits, setup.y_val, p, buf, lr_p, 0.9,
                               val_batch_size, rounds, setup.task, g)
-            w = _weighted_average(stacked, p)
+            agg = _weighted_average(stacked, p)
         else:
             train_loss[t] = float((p * losses).sum())
-            w = _weighted_average(stacked, agg_w)
+            agg = _weighted_average(stacked, agg_w)
+        if server_opt == "none":
+            w = agg
+        elif server_opt == "sgd":
+            w = w - server_lr * (w - agg)
+        else:  # adam on the pseudo-gradient g_t = w - agg
+            b1, b2, eps = 0.9, 0.99, 1e-3
+            g_t = w - agg
+            srv_m = b1 * srv_m + (1 - b1) * g_t
+            srv_v = b2 * srv_v + (1 - b2) * g_t * g_t
+            m_hat = srv_m / (1 - b1 ** (t + 1))
+            v_hat = srv_v / (1 - b2 ** (t + 1))
+            w = w - server_lr * m_hat / (torch.sqrt(v_hat) + eps)
         test_loss[t], test_acc[t] = _evaluate(w, setup)
         if verbose:  # reference per-round eval print (tools.py:236)
             print(f"[round {t:3d}] train loss {train_loss[t]:8.5f} | "
@@ -382,37 +410,41 @@ def _rounds(setup, aggregation, lr, epoch, batch_size, rounds, mu, lam,
 def FedAvg(setup, lr=0.01, epoch=2, batch_size=32, prox=False, mu=0.1,
            lambda_reg_if=False, lambda_reg=0.01, round=100, seed=0,
            lr_mode="reference", sequential=False, verbose=False,
-           participation=1.0, **_):
+           participation=1.0, server_opt="none", server_lr=1.0, **_):
     return _rounds(setup, "fixed", lr, epoch, batch_size, round,
                    mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
                    seed=seed, lr_mode=lr_mode, sequential=sequential,
-                   verbose=verbose, participation=participation)
+                   verbose=verbose, participation=participation,
+                   server_opt=server_opt, server_lr=server_lr)
 
 
 def FedProx(setup, lr=0.01, epoch=2, batch_size=32, prox=True, mu=0.1,
             lambda_reg_if=False, lambda_reg=0.01, round=100, seed=0,
             lr_mode="reference", sequential=False, verbose=False,
-            participation=1.0, **_):
+            participation=1.0, server_opt="none", server_lr=1.0, **_):
     return _rounds(setup, "fixed", lr, epoch, batch_size, round,
                    mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
                    seed=seed, lr_mode=lr_mode, sequential=sequential,
-                   verbose=verbose, participation=participation)
+                   verbose=verbose, participation=participation,
+                   server_opt=server_opt, server_lr=server_lr)
 
 
 def FedNova(setup, lr=0.01, epoch=2, batch_size=32, prox=False, mu=0.1,
             lambda_reg_if=False, lambda_reg=0.01, round=100, seed=0,
             lr_mode="reference", sequential=False, verbose=False,
-            participation=1.0, **_):
+            participation=1.0, server_opt="none", server_lr=1.0, **_):
     return _rounds(setup, "nova", lr, epoch, batch_size, round,
                    mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
                    seed=seed, lr_mode=lr_mode, sequential=sequential,
-                   verbose=verbose, participation=participation)
+                   verbose=verbose, participation=participation,
+                   server_opt=server_opt, server_lr=server_lr)
 
 
 def FedAMW(setup, lr=0.01, epoch=2, batch_size=32, prox=False, mu=0.1,
            lambda_reg_if=True, lambda_reg=0.01, round=100, lr_p=5e-5,
            val_batch_size=16, seed=0, lr_mode="reference",
-           sequential=False, verbose=False, participation=1.0, **_):
+           sequential=False, verbose=False, participation=1.0,
+           server_opt="none", server_lr=1.0, **_):
     if participation < 1.0:  # same contract as the JAX backend
         raise ValueError(
             "FedAMW assumes full participation; partial participation is "
@@ -421,7 +453,8 @@ def FedAMW(setup, lr=0.01, epoch=2, batch_size=32, prox=False, mu=0.1,
     return _rounds(setup, "learned", lr, epoch, batch_size, round,
                    mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
                    lr_p=lr_p, val_batch_size=val_batch_size, seed=seed,
-                   lr_mode=lr_mode, sequential=sequential, verbose=verbose)
+                   lr_mode=lr_mode, sequential=sequential, verbose=verbose,
+                   server_opt=server_opt, server_lr=server_lr)
 
 
 def _result(train_loss, test_loss, test_acc):

@@ -1,0 +1,107 @@
+"""FedOpt server optimizers (extension — Reddi et al. 2021,
+arXiv:2003.00295; the reference always overwrites the global model with
+the weighted average, ``tools.py:350``).
+
+The server update is one optimizer step on the pseudo-gradient
+``g_t = w_t - aggregate_t``. Invariant: ``server_opt="sgd"`` with
+``server_lr=1.0`` IS the reference rule.
+"""
+
+import numpy as np
+import pytest
+
+from fedamw_tpu.algorithms import FedAMW, FedAvg, prepare_setup
+from fedamw_tpu.backends import torch_ref
+from fedamw_tpu.data import load_dataset
+
+
+@pytest.fixture(scope="module")
+def setup6():
+    ds = load_dataset("digits", num_partitions=6, alpha=0.5)
+    return prepare_setup(ds, kernel_type="linear", seed=3,
+                         rng=np.random.RandomState(3))
+
+
+@pytest.fixture(scope="module")
+def tsetup6():
+    ds = load_dataset("digits", num_partitions=6, alpha=0.5)
+    return torch_ref.prepare_setup(ds, kernel_type="linear", seed=3,
+                                   rng=np.random.RandomState(3))
+
+
+KW = dict(lr=0.5, epoch=1, batch_size=32, round=4, seed=0,
+          lr_mode="constant")
+
+
+def test_server_sgd_lr1_is_reference_rule_jax(setup6):
+    vanilla = FedAvg(setup6, **KW)
+    sgd1 = FedAvg(setup6, server_opt="sgd", server_lr=1.0, **KW)
+    np.testing.assert_allclose(np.asarray(sgd1["test_acc"]),
+                               np.asarray(vanilla["test_acc"]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(sgd1["test_loss"]),
+                               np.asarray(vanilla["test_loss"]), atol=1e-5)
+
+
+def test_server_sgd_lr1_is_reference_rule_torch(tsetup6):
+    vanilla = torch_ref.FedAvg(tsetup6, **KW)
+    sgd1 = torch_ref.FedAvg(tsetup6, server_opt="sgd", server_lr=1.0, **KW)
+    np.testing.assert_allclose(np.asarray(sgd1["test_acc"]),
+                               np.asarray(vanilla["test_acc"]), atol=1e-4)
+
+
+@pytest.mark.parametrize("backend_fedavg", ["jax", "torch"])
+def test_fedadam_learns_and_differs(backend_fedavg, setup6, tsetup6):
+    fn, s = ((FedAvg, setup6) if backend_fedavg == "jax"
+             else (torch_ref.FedAvg, tsetup6))
+    vanilla = fn(s, **KW)
+    adam = fn(s, server_opt="adam", server_lr=0.1, **KW)
+    assert np.all(np.isfinite(np.asarray(adam["test_loss"])))
+    assert not np.allclose(np.asarray(adam["test_acc"]),
+                           np.asarray(vanilla["test_acc"]))
+    assert np.asarray(adam["test_acc"])[-1] > 50.0  # still learns
+
+
+def test_fedadam_matches_across_backends_on_fixed_stream(setup6, tsetup6):
+    """The adam formulas must agree exactly: drive both backends'
+    update rule with the same pseudo-gradient sequence."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import torch
+
+    rng = np.random.RandomState(0)
+    grads = [rng.randn(3, 5).astype(np.float32) for _ in range(6)]
+
+    tx = optax.adam(0.1, b1=0.9, b2=0.99, eps=1e-3)
+    w_j = jnp.zeros((3, 5))
+    st = tx.init(w_j)
+    for g in grads:
+        up, st = tx.update(jnp.asarray(g), st, w_j)
+        w_j = optax.apply_updates(w_j, up)
+
+    w_t = torch.zeros(3, 5)
+    m = torch.zeros(3, 5)
+    v = torch.zeros(3, 5)
+    b1, b2, eps = 0.9, 0.99, 1e-3
+    for t, g in enumerate(grads):
+        gt = torch.tensor(g)
+        m = b1 * m + (1 - b1) * gt
+        v = b2 * v + (1 - b2) * gt * gt
+        m_hat = m / (1 - b1 ** (t + 1))
+        v_hat = v / (1 - b2 ** (t + 1))
+        w_t = w_t - 0.1 * m_hat / (torch.sqrt(v_hat) + eps)
+    np.testing.assert_allclose(np.asarray(w_j), w_t.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("backend", ["jax", "torch"])
+def test_fedamw_rejects_server_opt(backend, setup6, tsetup6):
+    fn, s = ((FedAMW, setup6) if backend == "jax"
+             else (torch_ref.FedAMW, tsetup6))
+    with pytest.raises(ValueError, match="server_opt"):
+        fn(s, round=2, server_opt="adam")
+
+
+def test_invalid_server_opt_rejected(setup6):
+    with pytest.raises(ValueError, match="server_opt"):
+        FedAvg(setup6, round=2, server_opt="yogi")
