@@ -45,6 +45,17 @@ def parse_args():
                          "jitted round scans (reference tools.py:236)")
     ap.add_argument("--profile", type=str, default=None, metavar="DIR",
                     help="capture a jax.profiler trace of the run to DIR")
+    ap.add_argument("--participation", type=float, default=1.0,
+                    help="extension: per-round Bernoulli client sampling "
+                         "for FedAvg/FedProx (FedAMW always runs full "
+                         "participation; reference trains every client, "
+                         "tools.py:340)")
+    ap.add_argument("--server_opt", type=str, default="none",
+                    choices=["none", "sgd", "adam"],
+                    help="extension: FedOpt server optimizer on the "
+                         "pseudo-gradient for FedAvg/FedProx "
+                         "(none = reference overwrite rule)")
+    ap.add_argument("--server_lr", type=float, default=1.0)
     ap.add_argument("--save_models", type=str, default=None, metavar="DIR",
                     help="checkpoint each round-based algorithm's final "
                          "global params + mixture weights under DIR "
@@ -54,6 +65,14 @@ def parse_args():
 
 
 def main():
+    if os.environ.get("JAX_PLATFORMS"):
+        # honor the env var even under this container's sitecustomize,
+        # which force-registers the axon TPU plugin (the config update
+        # must land before the first backend query; with a remote-TPU
+        # tunnel down, env-only selection can hang in plugin init)
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     args = parse_args()
     from fedamw_tpu.config import get_parameter
     from fedamw_tpu.registry import get_backend
@@ -161,8 +180,18 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete):
             elif t == 0:
                 print("--save_models is implemented for the jax backend; "
                       f"ignored for backend={args.backend}")
-        avg = algos["FedAvg"](setup, lr=lr, **round_common)
-        prox = algos["FedProx"](setup, lr=lr, prox=True, mu=mu, **round_common)
+        # extensions apply to the fixed-weight algorithms only (FedAMW
+        # rejects both; its learned mixture weights assume every
+        # client's logits and the reference aggregation rule)
+        ext = dict(participation=args.participation,
+                   server_opt=args.server_opt, server_lr=args.server_lr)
+        if t == 0 and (args.participation < 1.0
+                       or args.server_opt != "none"):
+            print(f"extensions on FedAvg/FedProx: {ext} "
+                  "(FedAMW runs the reference protocol)")
+        avg = algos["FedAvg"](setup, lr=lr, **ext, **round_common)
+        prox = algos["FedProx"](setup, lr=lr, prox=True, mu=mu, **ext,
+                                **round_common)
         amw = algos["FedAMW"](setup, lr=lr, lambda_reg_if=True,
                               lambda_reg=lam, lr_p=lr_p, **round_common)
         for name, res, row in (("FedAvg", avg, 3), ("FedProx", prox, 4),

@@ -53,3 +53,22 @@ def test_tune_driver_standalone(tmp_path):
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "FedAMW final" in out.stdout
+
+
+def test_exp_driver_extension_flags(tmp_path):
+    """--participation/--server_opt apply to FedAvg/FedProx only;
+    FedAMW runs the reference protocol and the run must complete with
+    the same result schema."""
+    out = _run(
+        [os.path.join(REPO, "exp.py"), "--dataset", "digits",
+         "--backend", "jax", "--D", "128", "--num_partitions", "4",
+         "--round", "3", "--local_epoch", "1",
+         "--participation", "0.6", "--server_opt", "adam",
+         "--server_lr", "0.1", "--result_dir", str(tmp_path)],
+        cwd=str(tmp_path),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "extensions on FedAvg/FedProx" in out.stdout
+    with open(tmp_path / "exp1_digits.pkl", "rb") as f:
+        data = pickle.load(f)
+    assert data["test_acc"].shape == (6, 3, 1)

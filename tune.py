@@ -11,6 +11,7 @@ The execution backend is selected with ``--backend`` via the registry.
 
 import argparse
 import logging
+import os
 
 import numpy as np
 
@@ -50,6 +51,14 @@ def get_params():
 
 
 def main(args):
+    if os.environ.get("JAX_PLATFORMS"):
+        # honor the env var even under this container's sitecustomize,
+        # which force-registers the axon TPU plugin (the config update
+        # must land before the first backend query; with a remote-TPU
+        # tunnel down, env-only selection can hang in plugin init)
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     from fedamw_tpu.config import get_parameter
     from fedamw_tpu.data import load_dataset
     from fedamw_tpu.registry import get_backend
