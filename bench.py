@@ -154,13 +154,37 @@ def bench_torch(ds, D, rounds, algorithm="FedAvg", epoch=2, batch_size=32,
 
 
 def main():
-    if os.environ.get("JAX_PLATFORMS"):
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms:
         # honor the env var even under this container's sitecustomize,
         # which force-registers the axon TPU plugin (the config update
         # must land before the first backend query)
         import jax
 
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+        jax.config.update("jax_platforms", platforms)
+    if platforms != "cpu" and not os.environ.get("BENCH_NO_PROBE"):
+        # Fail fast instead of hanging forever when the remote-TPU
+        # tunnel is wedged (observed: a crashed Mosaic compile leaves
+        # the axon relay unreachable and the first backend query blocks
+        # indefinitely). A clean backend completes one tiny op in seconds
+        # (device listing alone can succeed while ops hang).
+        import subprocess
+        import sys as _sys
+
+        try:
+            subprocess.run(
+                [_sys.executable, "-c", "import numpy, jax.numpy as jnp; numpy.asarray(jnp.ones(2) + 1)"],
+                timeout=180, capture_output=True, check=True,
+            )
+        except subprocess.TimeoutExpired:
+            print("# bench aborted: device backend unreachable (remote "
+                  "tunnel down?) — no metrics emitted rather than a "
+                  "hang", file=sys.stderr)
+            raise SystemExit(1)
+        except subprocess.CalledProcessError as e:
+            print(f"# bench aborted: device probe failed: "
+                  f"{e.stderr[-500:]}", file=sys.stderr)
+            raise SystemExit(1)
     num_clients = int(os.environ.get("BENCH_CLIENTS", "256"))
     rounds = int(os.environ.get("BENCH_ROUNDS", "20"))
     D = int(os.environ.get("BENCH_D", "2000"))
