@@ -202,3 +202,18 @@ def test_participation_sharded_matches_unsharded(setup8):
                                atol=1e-5)
     np.testing.assert_allclose(res_s["test_acc"], res_u["test_acc"],
                                atol=1e-4)
+
+
+def test_fedopt_sharded_matches_unsharded(setup8):
+    """The FedAdam server step runs on replicated params after the
+    client-axis reduction; sharding must not change it."""
+    mesh = make_mesh()
+    sharded = shard_setup(setup8, mesh)
+    kw = dict(lr=0.5, epoch=1, round=4, seed=0, lr_mode="constant",
+              server_opt="adam", server_lr=0.1)
+    res_u = FedAvg(setup8, **kw)
+    res_s = FedAvg(sharded, **kw)
+    np.testing.assert_allclose(res_s["test_loss"], res_u["test_loss"],
+                               atol=1e-4)
+    np.testing.assert_allclose(res_s["test_acc"], res_u["test_acc"],
+                               atol=1e-3)
