@@ -217,3 +217,21 @@ def test_fedopt_sharded_matches_unsharded(setup8):
                                atol=1e-4)
     np.testing.assert_allclose(res_s["test_acc"], res_u["test_acc"],
                                atol=1e-3)
+
+
+def test_oneshot_sharded_matches_unsharded(setup8):
+    """FedAMW_OneShot's long local phase runs through the same bucketed
+    round kernel; sharding the client axis must not change the one-shot
+    mixture learning that follows."""
+    from fedamw_tpu.algorithms import FedAMW_OneShot
+
+    mesh = make_mesh()
+    sharded = shard_setup(setup8, mesh)
+    kw = dict(lr=0.5, epoch=2, round=3, lambda_reg=1e-4, lr_p=1e-3,
+              seed=0)
+    res_u = FedAMW_OneShot(setup8, **kw)
+    res_s = FedAMW_OneShot(sharded, **kw)
+    np.testing.assert_allclose(res_s["test_acc"], res_u["test_acc"],
+                               atol=1e-3)
+    np.testing.assert_allclose(res_s["test_loss"], res_u["test_loss"],
+                               atol=1e-4)
