@@ -1,0 +1,44 @@
+"""sweep.py — the NNI-free twin of the config.yml tuning flow."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_sweep_runs_trials_and_writes_report(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "sweep.py"),
+         "--dataset", "digits", "--trials", "2", "--round", "3",
+         "--seed", "0", "--out", str(tmp_path / "TUNING.md")],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    report = (tmp_path / "TUNING.md").read_text()
+    assert "| rank | lr_p | lambda_reg |" in report
+    # two ranked data rows, accuracies parsed back as floats
+    rows = [ln for ln in report.splitlines() if ln.startswith("| 1 |")
+            or ln.startswith("| 2 |")]
+    assert len(rows) == 2
+    accs = [float(r.split("|")[4]) for r in rows]
+    assert accs[0] >= accs[1]  # ranked by accuracy
+
+
+def test_sweep_samples_from_reference_grid():
+    import sweep
+
+    for lp, lam in [(lp, lam) for lp in sweep.LR_P_GRID
+                    for lam in sweep.LAMBDA_REG_GRID][:5]:
+        assert lp in sweep.LR_P_GRID and lam in sweep.LAMBDA_REG_GRID
+    # the grids mirror config.yml's search space values
+    import yaml
+
+    with open(os.path.join(REPO, "config.yml")) as f:
+        cfg = yaml.safe_load(f)
+    assert sweep.LR_P_GRID == cfg["searchSpace"]["lr_p"]["_value"]
+    assert (sweep.LAMBDA_REG_GRID
+            == cfg["searchSpace"]["lambda_reg"]["_value"])
