@@ -51,7 +51,7 @@ def parse_args():
                          "participation; reference trains every client, "
                          "tools.py:340)")
     ap.add_argument("--server_opt", type=str, default="none",
-                    choices=["none", "sgd", "adam"],
+                    choices=["none", "sgd", "adam", "yogi", "adagrad"],
                     help="extension: FedOpt server optimizer on the "
                          "pseudo-gradient for FedAvg/FedProx "
                          "(none = reference overwrite rule)")

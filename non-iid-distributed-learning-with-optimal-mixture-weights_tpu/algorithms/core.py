@@ -194,11 +194,19 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
     elif server_opt == "adam":
         import optax
 
-        # FedAdam hyperparameters per the FedOpt paper's defaults
+        # FedAdam/FedYogi hyperparameters per the FedOpt paper's defaults
         server_tx = optax.adam(server_lr, b1=0.9, b2=0.99, eps=1e-3)
+    elif server_opt == "yogi":
+        import optax
+
+        server_tx = optax.yogi(server_lr, b1=0.9, b2=0.99, eps=1e-3)
+    elif server_opt == "adagrad":
+        import optax
+
+        server_tx = optax.adagrad(server_lr)
     else:
-        raise ValueError(f"server_opt must be none|sgd|adam, got "
-                         f"{server_opt!r}")
+        raise ValueError(f"server_opt must be none|sgd|adam|yogi|adagrad, "
+                         f"got {server_opt!r}")
 
     @jax.jit
     def train(seed, X, y, idx, mask, X_test, y_test, lrs,

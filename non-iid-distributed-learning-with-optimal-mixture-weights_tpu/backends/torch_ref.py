@@ -310,9 +310,9 @@ def _rounds(setup, aggregation, lr, epoch, batch_size, rounds, mu, lam,
             lr_p=5e-5, val_batch_size=16, seed=0, lr_mode="reference",
             sequential=False, verbose=False, participation=1.0,
             server_opt="none", server_lr=1.0):
-    if server_opt not in ("none", "sgd", "adam"):
-        raise ValueError(f"server_opt must be none|sgd|adam, got "
-                         f"{server_opt!r}")
+    if server_opt not in ("none", "sgd", "adam", "yogi", "adagrad"):
+        raise ValueError(f"server_opt must be none|sgd|adam|yogi|adagrad, "
+                         f"got {server_opt!r}")
     if aggregation == "learned" and server_opt != "none":
         raise ValueError(
             "FedAMW aggregates with LEARNED mixture weights; composing "
@@ -343,10 +343,12 @@ def _rounds(setup, aggregation, lr, epoch, batch_size, rounds, mu, lam,
         agg_w = p
     buf = torch.zeros_like(p)
     # FedOpt server-optimizer state (extension; mirrors the JAX
-    # backend's optax.adam(b1=0.9, b2=0.99, eps=1e-3) formulas exactly,
-    # including bias correction)
-    srv_m = torch.zeros_like(w)
-    srv_v = torch.zeros_like(w)
+    # backend's optax formulas exactly, including bias correction and
+    # optax's accumulator initializations: adam 0, yogi 1e-6,
+    # adagrad 0.1)
+    srv_init = {"yogi": 1e-6, "adagrad": 0.1}.get(server_opt, 0.0)
+    srv_m = torch.full_like(w, srv_init)
+    srv_v = torch.full_like(w, srv_init)
     train_loss = np.zeros(rounds)
     test_loss = np.zeros(rounds)
     test_acc = np.zeros(rounds)
@@ -391,11 +393,23 @@ def _rounds(setup, aggregation, lr, epoch, batch_size, rounds, mu, lam,
             w = agg
         elif server_opt == "sgd":
             w = w - server_lr * (w - agg)
-        else:  # adam on the pseudo-gradient g_t = w - agg
+        elif server_opt == "adagrad":
+            # optax.adagrad: sum-of-squares (init 0.1), eps=1e-7 inside
+            # the rsqrt, zero-gated on empty accumulators
+            g_t = w - agg
+            srv_v = srv_v + g_t * g_t
+            inv = torch.where(srv_v > 0, torch.rsqrt(srv_v + 1e-7),
+                              torch.zeros_like(srv_v))
+            w = w - server_lr * g_t * inv
+        else:  # adam / yogi on the pseudo-gradient g_t = w - agg
             b1, b2, eps = 0.9, 0.99, 1e-3
             g_t = w - agg
             srv_m = b1 * srv_m + (1 - b1) * g_t
-            srv_v = b2 * srv_v + (1 - b2) * g_t * g_t
+            if server_opt == "yogi":
+                g2 = g_t * g_t
+                srv_v = srv_v - (1 - b2) * torch.sign(srv_v - g2) * g2
+            else:
+                srv_v = b2 * srv_v + (1 - b2) * g_t * g_t
             m_hat = srv_m / (1 - b1 ** (t + 1))
             v_hat = srv_v / (1 - b2 ** (t + 1))
             w = w - server_lr * m_hat / (torch.sqrt(v_hat) + eps)

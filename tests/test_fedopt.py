@@ -61,10 +61,12 @@ def test_fedadam_learns_and_differs(backend_fedavg, setup6, tsetup6):
     assert np.asarray(adam["test_acc"])[-1] > 50.0  # still learns
 
 
-def test_fedadam_matches_across_backends_on_fixed_stream(setup6, tsetup6):
-    """The adam formulas must agree exactly: drive both backends'
-    update rule with the same pseudo-gradient sequence."""
-    import jax
+@pytest.mark.parametrize("opt", ["adam", "yogi", "adagrad"])
+def test_fedopt_matches_across_backends_on_fixed_stream(opt):
+    """Each optimizer's formulas must agree exactly across backends:
+    drive both update rules with the same pseudo-gradient sequence
+    (the torch mirror replicates optax's math, accumulator inits, and
+    bias corrections)."""
     import jax.numpy as jnp
     import optax
     import torch
@@ -72,21 +74,34 @@ def test_fedadam_matches_across_backends_on_fixed_stream(setup6, tsetup6):
     rng = np.random.RandomState(0)
     grads = [rng.randn(3, 5).astype(np.float32) for _ in range(6)]
 
-    tx = optax.adam(0.1, b1=0.9, b2=0.99, eps=1e-3)
+    tx = {"adam": optax.adam(0.1, b1=0.9, b2=0.99, eps=1e-3),
+          "yogi": optax.yogi(0.1, b1=0.9, b2=0.99, eps=1e-3),
+          "adagrad": optax.adagrad(0.1)}[opt]
     w_j = jnp.zeros((3, 5))
     st = tx.init(w_j)
     for g in grads:
         up, st = tx.update(jnp.asarray(g), st, w_j)
         w_j = optax.apply_updates(w_j, up)
 
+    init = {"yogi": 1e-6, "adagrad": 0.1}.get(opt, 0.0)
     w_t = torch.zeros(3, 5)
-    m = torch.zeros(3, 5)
-    v = torch.zeros(3, 5)
+    m = torch.full((3, 5), init)
+    v = torch.full((3, 5), init)
     b1, b2, eps = 0.9, 0.99, 1e-3
     for t, g in enumerate(grads):
         gt = torch.tensor(g)
+        if opt == "adagrad":
+            v = v + gt * gt
+            inv = torch.where(v > 0, torch.rsqrt(v + 1e-7),
+                              torch.zeros_like(v))
+            w_t = w_t - 0.1 * gt * inv
+            continue
         m = b1 * m + (1 - b1) * gt
-        v = b2 * v + (1 - b2) * gt * gt
+        if opt == "yogi":
+            g2 = gt * gt
+            v = v - (1 - b2) * torch.sign(v - g2) * g2
+        else:
+            v = b2 * v + (1 - b2) * gt * gt
         m_hat = m / (1 - b1 ** (t + 1))
         v_hat = v / (1 - b2 ** (t + 1))
         w_t = w_t - 0.1 * m_hat / (torch.sqrt(v_hat) + eps)
@@ -104,4 +119,16 @@ def test_fedamw_rejects_server_opt(backend, setup6, tsetup6):
 
 def test_invalid_server_opt_rejected(setup6):
     with pytest.raises(ValueError, match="server_opt"):
-        FedAvg(setup6, round=2, server_opt="yogi")
+        FedAvg(setup6, round=2, server_opt="rmsprop")
+
+
+@pytest.mark.parametrize("opt,slr", [("yogi", 0.1), ("adagrad", 0.5)])
+@pytest.mark.parametrize("backend_fedavg", ["jax", "torch"])
+def test_fedyogi_adagrad_run_e2e(opt, slr, backend_fedavg, setup6, tsetup6):
+    # adagrad's monotone accumulator shrinks steps fast, so it needs a
+    # larger server_lr to clear the bar in 4 rounds
+    fn, s = ((FedAvg, setup6) if backend_fedavg == "jax"
+             else (torch_ref.FedAvg, tsetup6))
+    res = fn(s, server_opt=opt, server_lr=slr, **KW)
+    assert np.all(np.isfinite(np.asarray(res["test_loss"])))
+    assert np.asarray(res["test_acc"])[-1] > 50.0
