@@ -174,7 +174,7 @@ def main():
         try:
             subprocess.run(
                 [_sys.executable, "-c", "import numpy, jax.numpy as jnp; numpy.asarray(jnp.ones(2) + 1)"],
-                timeout=180, capture_output=True, check=True,
+                timeout=180, capture_output=True, check=True, text=True,
             )
         except subprocess.TimeoutExpired:
             print("# bench aborted: device backend unreachable (remote "
