@@ -24,9 +24,19 @@ Prints TWO JSON lines (headline metric LAST):
     {"metric": "client_updates_per_sec", "value": ..., "unit": "...",
      "vs_baseline": <speedup over torch-CPU>}
 
+When the reference checkout is mounted (``/root/reference``), a third
+arm times the reference's OWN loop (``functions/tools.py:329-463``,
+imported read-only) on the same tensors, and ``vs_baseline`` is
+computed against it — the literal "PyTorch-CPU wall-clock" of the
+north star; the repo-torch ratio is still reported as
+``vs_torch_backend``. Without the checkout, ``vs_baseline`` falls back
+to the repo-torch arm (conservative: it is faster than the reference).
+
 Env overrides: BENCH_CLIENTS (default 256), BENCH_ROUNDS (default 20),
 BENCH_D (default 2000), BENCH_TORCH_ROUNDS (default 2), BENCH_BUCKETS
-(default 32), BENCH_AMW_TORCH_ROUNDS (default 2), BENCH_PROFILE
+(default 32), BENCH_AMW_TORCH_ROUNDS (default 2), BENCH_REF_ROUNDS /
+BENCH_AMW_REF_ROUNDS (default 2), BENCH_NO_REFERENCE (skip the
+reference arm), BENCH_PROFILE
 (set to a directory to capture a jax.profiler trace of the timed run).
 """
 
@@ -135,6 +145,54 @@ def bench_jax_best(ds, D, rounds, algorithm="FedAvg", **kw):
     return best
 
 
+REFERENCE_ROOT = "/root/reference"
+
+
+def bench_reference(ds, D, rounds, algorithm="FedAvg", epoch=2,
+                    batch_size=32, lr=0.5):
+    """Time the ACTUAL reference loop (``functions/tools.py:329-463``),
+    imported read-only, on the same RFF-mapped tensors as the torch
+    arm — making "vs PyTorch reference" literal rather than a proxy
+    through this repo's (optimized, hence conservative) torch backend.
+    Returns (updates/s, acc, seconds) or None when the reference
+    checkout is absent.
+    """
+    if not os.path.isdir(REFERENCE_ROOT) or os.environ.get(
+            "BENCH_NO_REFERENCE"):
+        return None
+    import io
+
+    import torch
+    from torch.utils.data import DataLoader, TensorDataset
+
+    from oracle_parity import _load_oracle
+
+    rt = _load_oracle()  # scoped sys.path insert (no exp/tune shadowing)
+
+    from fedamw_tpu.backends import torch_ref
+
+    setup = torch_ref.prepare_setup(ds, D=D, kernel_par=0.1, seed=100,
+                                    rng=np.random.RandomState(100))
+    J = setup.num_clients
+    torch.manual_seed(100)
+    X_train = [setup.X[p] for p in setup.parts]
+    y_train = [setup.y[p] for p in setup.parts]
+    kw = dict(X_test=setup.X_test, y_test=setup.y_test, type=setup.task,
+              num_classes=setup.num_classes, D=setup.D, lr=lr,
+              epoch=epoch, batch_size=batch_size)
+    if algorithm == "FedAMW":
+        kw["validloader"] = DataLoader(
+            TensorDataset(setup.X_val, setup.y_val), 16, shuffle=True)
+    fn = getattr(rt, algorithm)
+    sink = io.StringIO()  # test_loop prints per round (tools.py:236)
+    with contextlib.redirect_stdout(sink):
+        fn(X_train, y_train, round=1, **kw)  # steady-state warmup
+        t0 = time.perf_counter()
+        _, _, acc = fn(X_train, y_train, round=rounds, **kw)
+        dt = time.perf_counter() - t0
+    return J * rounds / dt, float(np.asarray(acc).reshape(-1)[-1]), dt
+
+
 def bench_torch(ds, D, rounds, algorithm="FedAvg", epoch=2, batch_size=32,
                 lr=0.5, **kw):
     from fedamw_tpu.backends import torch_ref
@@ -202,13 +260,31 @@ def main():
         f"{torch_dt:.2f}s, acc {torch_acc:.2f})",
         file=sys.stderr,
     )
+    ref_rounds = int(os.environ.get("BENCH_REF_ROUNDS", "2"))
+    ref = bench_reference(ds, D, ref_rounds)
+    if ref is not None:
+        print(
+            f"# FedAvg  reference-loop: {ref[0]:.1f} updates/s "
+            f"({ref_rounds} rounds in {ref[2]:.2f}s, acc {ref[1]:.2f})",
+            file=sys.stderr,
+        )
+    # vs_baseline denominator: the ACTUAL reference loop when its
+    # checkout is present (the literal "PyTorch-CPU wall-clock" of the
+    # north star); this repo's optimized torch backend otherwise — that
+    # fallback is conservative (it is faster than the reference's loop).
+    base_ups, base_arm = ((ref[0], "reference-loop") if ref is not None
+                          else (torch_ups, "torch-backend"))
     headline = {
         "metric": "client_updates_per_sec",
         "value": round(jax_ups, 2),
         "unit": "client-updates/s",
-        "vs_baseline": round(jax_ups / torch_ups, 2),
+        "vs_baseline": round(jax_ups / base_ups, 2),
+        "baseline_arm": base_arm,
+        "vs_torch_backend": round(jax_ups / torch_ups, 2),
         "impl": jax_impl,
     }
+    if ref is not None:
+        headline["vs_reference_loop"] = round(jax_ups / ref[0], 2)
 
     # The FedAMW leg must never cost us the headline metric (it is the
     # slowest leg: the torch p-solver is O(rounds^2) in wall-clock).
@@ -224,13 +300,28 @@ def main():
             f"{amw_t_dt:.2f}s, acc {amw_t_acc:.2f})",
             file=sys.stderr,
         )
-        print(json.dumps({
+        amw_ref = bench_reference(
+            ds, D, int(os.environ.get("BENCH_AMW_REF_ROUNDS", "2")),
+            algorithm="FedAMW")
+        if amw_ref is not None:
+            print(f"# FedAMW  reference-loop: {amw_ref[0]:.1f} updates/s "
+                  f"in {amw_ref[2]:.2f}s, acc {amw_ref[1]:.2f}",
+                  file=sys.stderr)
+        amw_base, amw_base_arm = (
+            (amw_ref[0], "reference-loop") if amw_ref is not None
+            else (amw_t_ups, "torch-backend"))
+        amw_line = {
             "metric": "fedamw_client_updates_per_sec",
             "value": round(amw_ups, 2),
             "unit": "client-updates/s",
-            "vs_baseline": round(amw_ups / amw_t_ups, 2),
+            "vs_baseline": round(amw_ups / amw_base, 2),
+            "baseline_arm": amw_base_arm,
+            "vs_torch_backend": round(amw_ups / amw_t_ups, 2),
             "impl": amw_impl,
-        }))
+        }
+        if amw_ref is not None:
+            amw_line["vs_reference_loop"] = round(amw_ups / amw_ref[0], 2)
+        print(json.dumps(amw_line))
     except Exception as e:  # pragma: no cover - defensive
         print(f"# FedAMW leg failed: {e!r}", file=sys.stderr)
 

@@ -21,6 +21,16 @@ if os.environ.get("FEDAMW_TEST_PLATFORM", "cpu") == "cpu":
 
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", False)
+    # Persistent compilation cache: the suite is dominated by jit
+    # compiles of the fused round-scan programs (20s+ each for the mesh
+    # tests), which are identical run to run. Warm runs load them from
+    # disk instead of recompiling.
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 else:
     # FEDAMW_TEST_PLATFORM=tpu: leave the real backend in place so the
     # hardware-validation tests (tests/test_pallas_tpu.py) run against

@@ -203,10 +203,14 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete):
             if "params" in res:
                 from fedamw_tpu.utils.checkpoint import save_checkpoint
 
+                extra = {k: res[k]
+                         for k in ("p_opt", "server_opt",
+                                   "server_opt_kind")
+                         if k in res}
                 where = save_checkpoint(
                     os.path.join(args.save_models,
                                  f"{args.dataset}_{name}_repeat{t}"),
-                    res["params"], p=res["p"], round_idx=R,
+                    res["params"], p=res["p"], round_idx=R, extra=extra,
                 )
                 print(f"{name}: checkpoint -> {where}")
         print(f"[repeat {t}] wall time {time.time() - t0:.1f}s "

@@ -26,6 +26,7 @@ documented and switchable where meaningful):
 from __future__ import annotations
 
 import functools
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -120,7 +121,11 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
     # the full `rounds` horizon, but every per-round stream (client
     # shuffle keys, p-solver keys, participation keys, the LR schedule)
     # is generated for the FULL horizon and sliced — so prefix +
-    # checkpoint + resume reproduces the uninterrupted run exactly.
+    # checkpoint + resume reproduces the uninterrupted run exactly,
+    # PROVIDED the checkpoint carries the optimizer state when one is
+    # in play (FedAMW's p-momentum as 'p_opt', FedOpt's server state as
+    # 'server_opt' — both returned by return_state=True); without it
+    # the optimizer restarts at the boundary and _round_based warns.
     stop = stop_round
 
     def prologue(seed):
@@ -144,13 +149,20 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
         @jax.jit
         def train(seed, X, y, idx, mask, X_val, y_val,
                   X_test, y_test, lrs, p0, sizes, mu, lam,
-                  params0=None):
+                  params0=None, p_opt0=None):
             keys, params = prologue(seed)
             if params0 is not None:  # resume / warm start
                 params = params0
             pkeys = jax.random.split(
                 jax.random.PRNGKey(seed + 1), rounds)[start_round:stop]
             p, opt_state = p0, init_opt(p0)
+            if p_opt0 is not None:
+                # resume: the p-optimizer momentum buffer, shipped as a
+                # flat leaf tuple (checkpoint formats don't preserve
+                # optax's NamedTuple classes) and rebuilt against the
+                # freshly-initialized structure
+                opt_state = jax.tree.unflatten(
+                    jax.tree.structure(opt_state), list(p_opt0))
             # inert padded clients (mesh-even packing) never earn weight
             client_valid = (sizes > 0).astype(jnp.float32)
 
@@ -175,7 +187,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 body, (params, p, opt_state),
                 (jnp.arange(start_round, stop), lrs, keys, pkeys),
             )
-            return jnp.stack(metrics), params, p
+            return jnp.stack(metrics), params, p, opt_state
 
         return train
 
@@ -210,7 +222,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
 
     @jax.jit
     def train(seed, X, y, idx, mask, X_test, y_test, lrs,
-              p_fixed, sizes, mu, lam, params0=None):
+              p_fixed, sizes, mu, lam, params0=None, server_opt0=None):
         keys, params = prologue(seed)
         if params0 is not None:  # resume / warm start
             params = params0
@@ -268,11 +280,17 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
 
         opt_state0 = (() if server_tx is None
                       else server_tx.init(params))
-        (params, _), metrics = jax.lax.scan(
+        if server_opt0 is not None and server_tx is not None:
+            # resume: rebuild the server-optimizer state (Adam/Yogi
+            # moments AND the bias-correction count) from the flat leaf
+            # tuple a checkpoint carries
+            opt_state0 = jax.tree.unflatten(
+                jax.tree.structure(opt_state0), list(server_opt0))
+        (params, opt_state), metrics = jax.lax.scan(
             body, (params, opt_state0),
             (jnp.arange(start_round, stop), lrs, keys, part_keys)
         )
-        return jnp.stack(metrics), params, p_fixed
+        return jnp.stack(metrics), params, p_fixed, opt_state
 
     return train
 
@@ -573,22 +591,55 @@ def _round_based(
 
     params0 = None
     p0 = setup.p_fixed
+    opt0 = None  # p-optimizer (learned) / server-optimizer (FedOpt) state
     if resume_from is not None:
         params0 = jax.tree.map(jnp.asarray, resume_from["params"])
-        if aggregation == "learned" and resume_from.get("p") is not None:
-            # the learned mixture weights continue from the checkpoint;
-            # the p-optimizer's momentum buffer restarts at zero (the
-            # checkpoint layout does not carry it)
-            p0 = jnp.asarray(resume_from["p"])
+        opt_key = "p_opt" if aggregation == "learned" else "server_opt"
+        if resume_from.get(opt_key) is not None:
+            # guard against config drift: optax states of different
+            # optimizers can share a leaf structure (adam/yogi are both
+            # (count, mu, nu)), so a silent unflatten would reinterpret
+            # one's moments as the other's ('p_opt' needs no tag — the
+            # p-solver is always SGD(momentum=0.9))
+            saved_kind = resume_from.get("server_opt_kind")
+            if (opt_key == "server_opt" and saved_kind is not None
+                    and str(saved_kind) != server_opt):
+                raise ValueError(
+                    f"checkpoint's server_opt state was saved under "
+                    f"server_opt={str(saved_kind)!r} but this run uses "
+                    f"server_opt={server_opt!r}; resume with the same "
+                    f"server optimizer (or drop 'server_opt' from the "
+                    f"checkpoint to restart the optimizer)")
+            opt0 = tuple(jnp.asarray(x) for x in resume_from[opt_key])
+        if aggregation == "learned":
+            if resume_from.get("p") is not None:
+                p0 = jnp.asarray(resume_from["p"])
+            if opt0 is None:
+                warnings.warn(
+                    "resuming FedAMW from a checkpoint without 'p_opt': "
+                    "the p-optimizer momentum buffer restarts at zero, "
+                    "so the resumed run only approximates the "
+                    "uninterrupted one (save with return_state=True and "
+                    "pass res['p_opt'] through the checkpoint for exact "
+                    "resume)", stacklevel=3)
+        elif server_opt != "none" and opt0 is None:
+            warnings.warn(
+                f"resuming with server_opt={server_opt!r} from a "
+                "checkpoint without 'server_opt': the server optimizer's "
+                "moments and bias-correction count restart at the resume "
+                "boundary, so the resumed run only approximates the "
+                "uninterrupted one (save res['server_opt'] through the "
+                "checkpoint for exact resume)", stacklevel=3)
 
     if aggregation == "learned":
         args = (seed, setup.X, setup.y, idx_tup, mask_tup,
                 setup.X_val, setup.y_val, setup.X_test, setup.y_test,
-                lrs, p0, setup.sizes, float(mu), float(lam), params0)
+                lrs, p0, setup.sizes, float(mu), float(lam), params0,
+                opt0)
     else:
         args = (seed, setup.X, setup.y, idx_tup, mask_tup,
                 setup.X_test, setup.y_test, lrs,
-                p0, setup.sizes, float(mu), float(lam), params0)
+                p0, setup.sizes, float(mu), float(lam), params0, opt0)
 
     if analyze_memory:
         # AOT device-memory report for the WHOLE fused training program
@@ -604,15 +655,22 @@ def _round_based(
             if getattr(ma, k, None) is not None
         }
 
-    metrics, fparams, fp = train(*args)
+    metrics, fparams, fp, fopt = train(*args)
 
     metrics = np.asarray(metrics)
     out = result_tuple(metrics[0], metrics[1], metrics[2])
     if return_state:
-        # final global model + mixture weights, for checkpointing
-        # (utils/checkpoint.py); left on device unless the caller saves
+        # final global model + mixture weights + optimizer state, for
+        # checkpointing (utils/checkpoint.py); optimizer state travels
+        # as a flat leaf tuple because checkpoint formats don't preserve
+        # optax's NamedTuple classes (left on device unless saved)
         out["params"] = fparams
         out["p"] = fp
+        if aggregation == "learned":
+            out["p_opt"] = tuple(jax.tree.leaves(fopt))
+        elif server_opt != "none":
+            out["server_opt"] = tuple(jax.tree.leaves(fopt))
+            out["server_opt_kind"] = server_opt
     return out
 
 

@@ -21,7 +21,13 @@ import numpy as np
 def _to_host(tree):
     import jax
 
-    return jax.tree.map(lambda x: np.asarray(x), tree)
+    # leave plain-Python scalars/strings (e.g. 'server_opt_kind') alone:
+    # a 0-d numpy str array would round-trip poorly through orbax
+    return jax.tree.map(
+        lambda x: x if isinstance(x, (str, bool, int, float))
+        else np.asarray(x),
+        tree,
+    )
 
 
 def save_checkpoint(path: str, params, p=None, round_idx: int | None = None,
@@ -34,7 +40,9 @@ def save_checkpoint(path: str, params, p=None, round_idx: int | None = None,
     if round_idx is not None:
         state["round"] = int(round_idx)
     if extra:
-        state.update(extra)
+        # e.g. optimizer-state leaf tuples ('p_opt'/'server_opt' from
+        # return_state=True) — host-convert like params
+        state.update({k: _to_host(v) for k, v in extra.items()})
     os.makedirs(path, exist_ok=True)
     try:
         import orbax.checkpoint as ocp

@@ -1,0 +1,304 @@
+"""Three-way parity: the ACTUAL reference code as a read-only oracle.
+
+Imports ``/root/reference/functions/tools.py`` (never copied, never
+modified) and feeds the SAME RFF-mapped digits tensors — produced by
+this repo's torch ``prepare_setup`` — through the reference's own
+``Centralized``/``Distributed``/``FedAMW_OneShot``/``FedAvg``/
+``FedProx``/``FedNova``/``FedAMW`` (``tools.py:240-463``), then runs
+this repo's torch and JAX backends on the same partitions/val-split and
+compares final test accuracies across seeds. This closes the round-2
+gap where "identical final test accuracy" rested on a
+same-author-both-sides comparison (VERDICT.md, missing #1).
+
+Repo arms run with ``sequential=True``: the reference passes one model
+object through the client loop, so client i+1 starts from client i's
+weights (SURVEY.md §2.3.1) — the repo's compat switch reproduces that
+semantics; the default-parallel delta is reported separately.
+
+The operating point (digits, J=20, alpha=0.5, D=500, R=30, lr=2.0) is
+the non-degenerate anchor: FedAvg/FedProx genuinely learn here
+(~9% -> ~85%+), unlike the alpha=0.01 anchor where fixed-p averaging
+pins accuracy at the constant-argmax frequency (VERDICT.md, weak #2).
+
+Usage:
+  JAX_PLATFORMS=cpu python oracle_parity.py [--seeds 5] [--round 30]
+      [--out results_parity/oracle_summary.json]
+  python oracle_parity.py --render results_parity/oracle_summary.json
+"""
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REFERENCE_ROOT = "/root/reference"
+
+# the anchor hyperparameters (digits registry values except lr, which is
+# re-tuned so FedAvg learns at alpha=0.5 — see module docstring)
+ANCHOR = dict(
+    dataset="digits", num_partitions=20, alpha=0.5, D=500,
+    kernel_par=0.1, lr=2.0, epoch=2, batch_size=32,
+    mu=0.0001, lambda_reg=0.0005, lambda_reg_os=0.0005,
+    lr_p=5e-6, lr_p_os=0.005,
+)
+ALGOS = ["CL", "DL", "FedAMW_OneShot", "FedAvg", "FedProx", "FedNova",
+         "FedAMW"]
+
+
+def _load_oracle():
+    """Import the reference package read-only, without copying it.
+
+    The path entry is removed again immediately: the reference checkout
+    has top-level ``exp.py``/``tune.py`` that would otherwise shadow
+    this repo's same-named modules for the rest of the process (e.g. a
+    later in-process ``import tune`` would hit the reference's, which
+    unconditionally imports NNI).
+    """
+    sys.path.insert(0, REFERENCE_ROOT)
+    try:
+        import functions.tools as reference_tools
+    finally:
+        sys.path.remove(REFERENCE_ROOT)
+    return reference_tools
+
+
+def _final(res):
+    return float(np.asarray(res["test_acc"]).reshape(-1)[-1])
+
+
+def run_oracle(setup, rounds, seed):
+    """Run all seven reference algorithms (tools.py:240-463) on the
+    repo-produced tensors. Returns {algo: final_test_acc}."""
+    import torch
+    from torch.utils.data import DataLoader, TensorDataset
+
+    rt = _load_oracle()
+    torch.manual_seed(seed)
+    X_train = [setup.X[p] for p in setup.parts]
+    y_train = [setup.y[p] for p in setup.parts]
+    kw = dict(X_test=setup.X_test, y_test=setup.y_test, type=setup.task,
+              num_classes=setup.num_classes, D=setup.D,
+              batch_size=ANCHOR["batch_size"])
+    # reference pooled val loader: batch 16, shuffled (exp.py:99)
+    validloader = DataLoader(TensorDataset(setup.X_val, setup.y_val),
+                             batch_size=16, shuffle=True)
+    lr, ep = ANCHOR["lr"], ANCHOR["epoch"]
+    out = {}
+    sink = io.StringIO()  # test_loop prints every call (tools.py:236)
+    with contextlib.redirect_stdout(sink):
+        _, _, acc = rt.Centralized(X_train, y_train, lr=lr,
+                                   epoch=ep * rounds, **kw)
+        out["CL"] = float(acc)
+        _, _, acc = rt.Distributed(X_train, y_train, lr=lr,
+                                   epoch=ep * rounds, **kw)
+        out["DL"] = float(acc)
+        _, _, acc = rt.FedAMW_OneShot(
+            X_train, y_train, validloader=validloader, lr=lr,
+            epoch=ep * rounds, lambda_reg_if=True,
+            lambda_reg=ANCHOR["lambda_reg_os"], round=rounds,
+            lr_p=ANCHOR["lr_p_os"], **kw)
+        out["FedAMW_OneShot"] = float(acc[-1])
+        _, _, acc = rt.FedAvg(X_train, y_train, lr=lr, epoch=ep,
+                              round=rounds, **kw)
+        out["FedAvg"] = float(acc[-1])
+        _, _, acc = rt.FedProx(X_train, y_train, lr=lr, epoch=ep,
+                               prox=True, mu=ANCHOR["mu"], round=rounds,
+                               **kw)
+        out["FedProx"] = float(acc[-1])
+        _, _, acc = rt.FedNova(X_train, y_train, lr=lr, epoch=ep,
+                               round=rounds, **kw)
+        out["FedNova"] = float(acc[-1])
+        _, _, acc = rt.FedAMW(X_train, y_train, validloader=validloader,
+                              lr=lr, epoch=ep, lambda_reg_if=True,
+                              lambda_reg=ANCHOR["lambda_reg"],
+                              round=rounds, lr_p=ANCHOR["lr_p"], **kw)
+        out["FedAMW"] = float(acc[-1])
+    return out
+
+
+def run_repo(backend_name, rounds, seed, sequential=True):
+    """Run the repo backend on the same partitions/val split.
+    Returns {algo: final_test_acc}."""
+    from fedamw_tpu.data import load_dataset
+    from fedamw_tpu.registry import get_backend
+
+    be = get_backend(backend_name)
+    rng = np.random.RandomState(seed)
+    ds = load_dataset(ANCHOR["dataset"], ANCHOR["num_partitions"],
+                      ANCHOR["alpha"], rng=rng)
+    setup = be.prepare_setup(ds, D=ANCHOR["D"],
+                             kernel_par=ANCHOR["kernel_par"],
+                             seed=seed, rng=rng)
+    lr, ep, bs = ANCHOR["lr"], ANCHOR["epoch"], ANCHOR["batch_size"]
+    common = dict(batch_size=bs, seed=seed, sequential=sequential)
+    a = be.ALGORITHMS
+    out = {
+        "CL": _final(a["Centralized"](setup, lr=lr, epoch=ep * rounds,
+                                      **common)),
+        "DL": _final(a["Distributed"](setup, lr=lr, epoch=ep * rounds,
+                                      **common)),
+        "FedAMW_OneShot": _final(a["FedAMW_OneShot"](
+            setup, lr=lr, epoch=ep * rounds, lambda_reg_if=True,
+            lambda_reg=ANCHOR["lambda_reg_os"], round=rounds,
+            lr_p=ANCHOR["lr_p_os"], **common)),
+        "FedAvg": _final(a["FedAvg"](setup, lr=lr, epoch=ep,
+                                     round=rounds, **common)),
+        "FedProx": _final(a["FedProx"](setup, lr=lr, epoch=ep, prox=True,
+                                       mu=ANCHOR["mu"], round=rounds,
+                                       **common)),
+        "FedNova": _final(a["FedNova"](setup, lr=lr, epoch=ep,
+                                       round=rounds, **common)),
+        "FedAMW": _final(a["FedAMW"](setup, lr=lr, epoch=ep,
+                                     lambda_reg_if=True,
+                                     lambda_reg=ANCHOR["lambda_reg"],
+                                     round=rounds, lr_p=ANCHOR["lr_p"],
+                                     **common)),
+    }
+    return out
+
+
+def _build_torch_setup(seed):
+    from fedamw_tpu.backends import torch_ref
+    from fedamw_tpu.data import load_dataset
+
+    rng = np.random.RandomState(seed)
+    ds = load_dataset(ANCHOR["dataset"], ANCHOR["num_partitions"],
+                      ANCHOR["alpha"], rng=rng)
+    return torch_ref.prepare_setup(ds, D=ANCHOR["D"],
+                                   kernel_par=ANCHOR["kernel_par"],
+                                   seed=seed, rng=rng)
+
+
+def collect(seeds, rounds, out_path, with_parallel=True):
+    summary = {
+        "anchor": {**ANCHOR, "round": rounds},
+        "seeds": list(seeds),
+        "arms": {"reference": [], "torch_seq": [], "jax_seq": []},
+    }
+    if with_parallel:
+        summary["arms"]["jax_parallel"] = []
+    for s in seeds:
+        t0 = time.time()
+        setup = _build_torch_setup(s)
+        summary["arms"]["reference"].append(run_oracle(setup, rounds, s))
+        summary["arms"]["torch_seq"].append(run_repo("torch", rounds, s))
+        summary["arms"]["jax_seq"].append(run_repo("jax", rounds, s))
+        if with_parallel:
+            summary["arms"]["jax_parallel"].append(
+                run_repo("jax", rounds, s, sequential=False))
+        print(f"[seed {s}] done in {time.time() - t0:.1f}s", flush=True)
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(summary, f, indent=1)
+    print(f"summary -> {out_path}")
+    return summary
+
+
+def render(summary):
+    """Markdown table: reference oracle vs repo arms, with the
+    reference's own paired t-test (functions/utils.py:351-353)."""
+    from fedamw_tpu.utils.reporting import check_significance
+
+    arms = summary["arms"]
+    acc = {arm: {a: np.array([r[a] for r in runs])
+                 for a in ALGOS}
+           for arm, runs in arms.items()}
+    n = len(summary["seeds"])
+    a_cfg = summary["anchor"]
+    lines = [
+        "## Parity vs the actual reference code (oracle import)",
+        "",
+        f"`oracle_parity.py` imports `/root/reference/functions/tools.py`",
+        "read-only and feeds the SAME RFF-mapped tensors (this repo's",
+        "torch `prepare_setup` output, identical partitions + val split)",
+        "through the reference's own algorithm functions",
+        "(`tools.py:240-463`). Repo arms run `sequential=True` to match",
+        "the reference's client-contamination semantics (SURVEY.md",
+        f"§2.3.1). Anchor: {a_cfg['dataset']}, J={a_cfg['num_partitions']},",
+        f"alpha={a_cfg['alpha']}, D={a_cfg['D']}, R={a_cfg['round']},",
+        f"lr={a_cfg['lr']}, {n} seeds {summary['seeds']} — chosen so",
+        "FedAvg/FedProx genuinely learn (no degenerate rows).",
+        "",
+        "| Algorithm | reference | repo-torch (seq) | repo-JAX (seq) |"
+        " Δ(jax-ref) | t-test vs ref | parity |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    all_ok = True
+    band = 2.0
+    for algo in ALGOS:
+        r = acc["reference"][algo]
+        tq = acc["torch_seq"][algo]
+        jq = acc["jax_seq"][algo]
+        d = jq.mean() - r.mean()
+        jax_beats = check_significance(r, jq)
+        ref_beats = check_significance(jq, r)
+        winner = ("jax" if jax_beats else
+                  "reference" if ref_beats else "none")
+        ok = abs(d) <= band or winner == "none"
+        all_ok &= ok
+        lines.append(
+            f"| {algo} | {r.mean():.2f}±{r.std():.2f} | "
+            f"{tq.mean():.2f}±{tq.std():.2f} | "
+            f"{jq.mean():.2f}±{jq.std():.2f} | {d:+.2f} | {winner} | "
+            f"{'YES' if ok else 'NO'} |")
+    lines.append("")
+    lines.append(
+        f"Parity = |Δmean| <= {band} accuracy points OR the reference's"
+        " paired t-test (threshold 1.812) finds no significant winner"
+        " in either direction.")
+    if "jax_parallel" in acc:
+        deltas = ", ".join(
+            f"{algo} {acc['jax_parallel'][algo].mean() - acc['jax_seq'][algo].mean():+.2f}"
+            for algo in ALGOS)
+        lines.append("")
+        lines.append(
+            "Default-parallel JAX (every client starts from the round's"
+            " global weights — the paper's semantics, repo default) vs"
+            f" sequential compat, Δmean accuracy: {deltas}. The large"
+            " deltas are an operating-point effect, not a defect: the"
+            " reference's contamination chain applies J*epoch"
+            " consecutive SGD passes to ONE model per round, so at an"
+            " lr tuned for that chain, averaging J independent"
+            " 2-epoch updates moves far less per round; parallel"
+            " semantics needs its own lr/round budget (the paper's"
+            " convergence analysis assumes the parallel form).")
+    lines.append("")
+    lines.append(f"Overall: {'PARITY WITH THE REFERENCE ORACLE' if all_ok else 'FAILURES — see table'}.")
+    return "\n".join(lines), all_ok
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seeds", type=int, default=5)
+    ap.add_argument("--seed0", type=int, default=100)
+    ap.add_argument("--round", type=int, default=30)
+    ap.add_argument("--out", type=str,
+                    default="results_parity/oracle_summary.json")
+    ap.add_argument("--render", type=str, default=None, metavar="JSON",
+                    help="render markdown from an existing summary "
+                         "instead of running")
+    args = ap.parse_args()
+    if args.render:
+        with open(args.render) as f:
+            summary = json.load(f)
+        text, ok = render(summary)
+        print(text)
+        return 0 if ok else 1
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    summary = collect(range(args.seed0, args.seed0 + args.seeds),
+                      args.round, args.out)
+    text, ok = render(summary)
+    print(text)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -135,9 +135,10 @@ def test_resume_validates_window():
 
 
 def test_fedamw_resume_continues_mixture_weights():
-    """FedAMW resume: params and the learned p continue from the
-    checkpoint (the p-optimizer momentum buffer restarts at zero, so
-    equivalence is approximate, not bitwise — documented)."""
+    """FedAMW exact resume: params, the learned p, AND the p-optimizer
+    momentum buffer ('p_opt' from return_state=True) continue from the
+    checkpoint, so prefix + resume == the uninterrupted run, like the
+    FedAvg test above."""
     import numpy as np
 
     from fedamw_tpu.algorithms import FedAMW, prepare_setup
@@ -153,10 +154,87 @@ def test_fedamw_resume_continues_mixture_weights():
     prefix = FedAMW(setup, round=4, stop_round=2, return_state=True, **kw)
     resumed = FedAMW(setup, round=4, start_round=2,
                      resume_from={"params": prefix["params"],
-                                  "p": prefix["p"]},
+                                  "p": prefix["p"],
+                                  "p_opt": prefix["p_opt"]},
                      return_state=True, **kw)
     # resumed p must continue from the prefix's p, not reinit to n_j/n
     assert not np.allclose(np.asarray(resumed["p"]),
                            np.asarray(setup.p_fixed))
+    np.testing.assert_array_equal(np.asarray(resumed["test_acc"]),
+                                  np.asarray(full["test_acc"])[2:])
+    np.testing.assert_array_equal(np.asarray(resumed["train_loss"]),
+                                  np.asarray(full["train_loss"])[2:])
+    np.testing.assert_array_equal(np.asarray(resumed["p"]),
+                                  np.asarray(full["p"]))
+
+
+def test_fedamw_resume_without_p_opt_warns_and_approximates():
+    """Resuming from a checkpoint lacking 'p_opt' (e.g. one written
+    before round 3) warns and restarts the momentum buffer — still a
+    valid continuation, just approximate."""
+    import numpy as np
+    import pytest
+
+    from fedamw_tpu.algorithms import FedAMW, prepare_setup
+    from fedamw_tpu.data import load_dataset
+
+    ds = load_dataset("digits", num_partitions=4, alpha=0.5)
+    setup = prepare_setup(ds, kernel_type="linear", seed=5,
+                          rng=np.random.RandomState(5))
+    kw = dict(lr=0.5, epoch=1, batch_size=32, lambda_reg=1e-4, lr_p=1e-3,
+              seed=1, lr_mode="constant")
+    full = FedAMW(setup, round=4, return_state=True, **kw)
+    prefix = FedAMW(setup, round=4, stop_round=2, return_state=True, **kw)
+    with pytest.warns(UserWarning, match="p_opt"):
+        resumed = FedAMW(setup, round=4, start_round=2,
+                         resume_from={"params": prefix["params"],
+                                      "p": prefix["p"]}, **kw)
     np.testing.assert_allclose(np.asarray(resumed["test_acc"])[-1],
                                np.asarray(full["test_acc"])[-1], atol=2.0)
+
+
+def test_fedopt_resume_carries_server_state(tmp_path):
+    """FedAvg + server_opt='adam' exact resume: the Adam moments and
+    bias-correction count travel through the checkpoint as the
+    'server_opt' leaf tuple (ADVICE r2: without this, resume silently
+    reinitialized the server optimizer)."""
+    import numpy as np
+    import pytest
+
+    from fedamw_tpu.algorithms import FedAvg, prepare_setup
+    from fedamw_tpu.data import load_dataset
+    from fedamw_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+    ds = load_dataset("digits", num_partitions=4, alpha=0.5)
+    setup = prepare_setup(ds, kernel_type="linear", seed=7,
+                          rng=np.random.RandomState(7))
+    kw = dict(lr=0.5, epoch=1, batch_size=32, seed=2, lr_mode="constant",
+              server_opt="adam", server_lr=0.1)
+
+    full = FedAvg(setup, round=4, return_state=True, **kw)
+    prefix = FedAvg(setup, round=4, stop_round=2, return_state=True, **kw)
+    save_checkpoint(str(tmp_path / "ck"), prefix["params"],
+                    p=prefix["p"], round_idx=2,
+                    extra={"server_opt": prefix["server_opt"],
+                           "server_opt_kind": prefix["server_opt_kind"]})
+    state = load_checkpoint(str(tmp_path / "ck"))
+    resumed = FedAvg(setup, round=4, start_round=int(state["round"]),
+                     resume_from=state, **kw)
+    np.testing.assert_allclose(np.asarray(resumed["test_acc"]),
+                               np.asarray(full["test_acc"])[2:], atol=1e-5)
+    np.testing.assert_allclose(np.asarray(resumed["train_loss"]),
+                               np.asarray(full["train_loss"])[2:],
+                               atol=1e-6)
+
+    # and without the state: a warning + approximate continuation
+    with pytest.warns(UserWarning, match="server_opt"):
+        FedAvg(setup, round=4, start_round=2,
+               resume_from={"params": prefix["params"]}, **kw)
+
+    # config drift must be rejected, not silently reinterpreted:
+    # adam and yogi states share a leaf structure, so without the kind
+    # tag yogi would happily consume adam's moments
+    assert state.get("server_opt_kind") == "adam"
+    with pytest.raises(ValueError, match="server_opt"):
+        FedAvg(setup, round=4, start_round=2, resume_from=state,
+               **{**kw, "server_opt": "yogi"})

@@ -1,0 +1,83 @@
+"""The ACTUAL reference code as a read-only parity oracle (fast tier).
+
+``oracle_parity.py`` is the full 5-seed harness behind PARITY.md §1;
+this test pins the capability in CI at a small operating point: import
+``/root/reference/functions/tools.py`` (never copied), feed it the SAME
+RFF-mapped tensors as the repo's torch backend, and require agreement.
+Skips when the reference checkout is absent (other machines).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import oracle_parity
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(oracle_parity.REFERENCE_ROOT),
+    reason="reference checkout not mounted",
+)
+
+ROUNDS = 8
+SEED = 100
+
+
+@pytest.fixture(scope="module")
+def arms():
+    # smaller than the PARITY.md anchor so the sequential oracle loop
+    # stays test-sized; same digits/alpha=0.5 regime where FedAvg learns
+    overrides = dict(num_partitions=8, D=128)
+    saved = dict(oracle_parity.ANCHOR)
+    oracle_parity.ANCHOR.update(overrides)
+    try:
+        setup = oracle_parity._build_torch_setup(SEED)
+        ref = oracle_parity.run_oracle(setup, ROUNDS, SEED)
+        repo = oracle_parity.run_repo("torch", ROUNDS, SEED)
+    finally:
+        oracle_parity.ANCHOR.clear()
+        oracle_parity.ANCHOR.update(saved)
+    return ref, repo
+
+
+def test_oracle_import_does_not_shadow_repo_modules():
+    """The reference checkout has top-level exp.py/tune.py; loading the
+    oracle must not leave /root/reference on sys.path where a later
+    in-process ``import tune`` (sweep.py does this) would resolve to the
+    reference's NNI-importing driver instead of this repo's."""
+    import sys
+
+    oracle_parity._load_oracle()
+    assert oracle_parity.REFERENCE_ROOT not in sys.path
+    import tune
+
+    assert os.path.dirname(os.path.abspath(tune.__file__)) != \
+        oracle_parity.REFERENCE_ROOT
+
+
+def test_oracle_runs_all_seven_and_learns(arms):
+    ref, _ = arms
+    assert set(ref) == set(oracle_parity.ALGOS)
+    assert all(np.isfinite(v) for v in ref.values())
+    # non-degenerate: the reference genuinely learns at this anchor
+    # (digits majority-class frequency is ~10%)
+    assert ref["FedAvg"] > 40.0
+    assert ref["FedAMW"] > 40.0
+
+
+def test_repo_torch_matches_oracle(arms):
+    """Same tensors, same sequential semantics, independent
+    implementations; single seed, so the band covers shuffle/init RNG
+    noise (the 5-seed statistical test lives in PARITY.md §1)."""
+    ref, repo = arms
+    for algo in oracle_parity.ALGOS:
+        # FedAMW_OneShot: the reference has the aliasing bug (client 0's
+        # stored weights get re-scaled by p[0] every p-iteration,
+        # tools.py:318-320 — compounding to p[0]^t), which the repo
+        # deliberately does NOT reproduce. At J=8 effectively deleting
+        # client 0 from the ensemble is material, so the bug itself
+        # creates a real gap; at the PARITY.md anchor (J=20, 5 seeds)
+        # the arms still agree statistically.
+        band = 25.0 if algo == "FedAMW_OneShot" else 12.0
+        assert abs(ref[algo] - repo[algo]) <= band, (
+            f"{algo}: oracle {ref[algo]:.2f} vs repo {repo[algo]:.2f}")
