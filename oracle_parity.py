@@ -78,6 +78,9 @@ def run_oracle(setup, rounds, seed):
     from torch.utils.data import DataLoader, TensorDataset
 
     rt = _load_oracle()
+    # the reference pins its module-global device to CUDA when available
+    # (tools.py:12); this harness compares CPU-to-CPU on CPU tensors
+    rt.device = torch.device("cpu")
     torch.manual_seed(seed)
     X_train = [setup.X[p] for p in setup.parts]
     y_train = [setup.y[p] for p in setup.parts]

@@ -56,6 +56,42 @@ _CHILD = textwrap.dedent("""
 """)
 
 
+_TRAIN_CHILD = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.environ["FEDAMW_REPO"])
+    import numpy as np
+
+    from fedamw_tpu.parallel import initialize_multihost, make_mesh, \\
+        shard_setup
+
+    addr, pid = sys.argv[1], int(sys.argv[2])
+    n = initialize_multihost(coordinator_address=addr, num_processes=2,
+                             process_id=pid)
+    assert n == 4, n  # 2 hosts x 2 devices: a DCN x ICI layout in miniature
+
+    from fedamw_tpu.algorithms import FedAMW, FedAvg, prepare_setup
+    from fedamw_tpu.data import load_dataset
+
+    ds = load_dataset("digits", num_partitions=6, alpha=0.5,
+                      rng=np.random.RandomState(7))
+    setup = prepare_setup(ds, D=64, kernel_par=0.1, seed=7,
+                          rng=np.random.RandomState(7), buckets=2,
+                          client_multiple=4)
+    setup = shard_setup(setup, make_mesh())
+    res = FedAvg(setup, lr=0.5, epoch=1, batch_size=16, round=2, seed=0,
+                 lr_mode="constant")
+    res2 = FedAMW(setup, lr=0.5, epoch=1, batch_size=16, round=2,
+                  lambda_reg=1e-4, lr_p=1e-3, seed=0, lr_mode="constant")
+    print(f"MHTRAIN pid={pid} "
+          f"fedavg={float(res['test_acc'][-1]):.6f} "
+          f"fedamw={float(res2['test_acc'][-1]):.6f}", flush=True)
+""")
+
+
 def _free_port():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -93,3 +129,64 @@ def test_two_process_init_and_cross_host_aggregation(tmp_path):
     assert len(accs) == 2
     np.testing.assert_allclose(
         [float(a.split("agg=")[1]) for a in accs], [1.75, 1.75])
+
+
+def test_two_process_full_training_matches_single_process(tmp_path):
+    """The FULL training path — bucketed vmapped local SGD, FedAMW's
+    p-solver over cached logits, weighted aggregation, eval — jitted
+    over a 4-device global mesh spanning 2 processes (2 local devices
+    each: DCN x ICI in miniature). Both ranks must report identical
+    metrics, and they must match the same program on a single-process
+    4-device mesh (the pjit promise: placement changes, the program
+    doesn't)."""
+    script = tmp_path / "train_child.py"
+    script.write_text(_TRAIN_CHILD)
+    addr = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env["FEDAMW_REPO"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), addr, str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for pr in procs:
+            out, _ = pr.communicate(timeout=280)
+            outs.append(out)
+    finally:
+        for pr in procs:
+            pr.kill()
+    lines = {}
+    for pid, (pr, out) in enumerate(zip(procs, outs)):
+        assert pr.returncode == 0, f"child {pid} failed:\n{out[-3000:]}"
+        (line,) = [ln for ln in out.splitlines()
+                   if ln.startswith("MHTRAIN")]
+        lines[pid] = line.split(" ", 2)[2]
+    assert lines[0] == lines[1]  # SPMD: every rank sees the same metrics
+
+    # single-process reference: same setup on 4 of this process's 8
+    # virtual devices (identical logical mesh)
+    from fedamw_tpu.algorithms import FedAMW, FedAvg, prepare_setup
+    from fedamw_tpu.data import load_dataset
+    from fedamw_tpu.parallel import make_mesh, shard_setup
+
+    ds = load_dataset("digits", num_partitions=6, alpha=0.5,
+                      rng=np.random.RandomState(7))
+    setup = prepare_setup(ds, D=64, kernel_par=0.1, seed=7,
+                          rng=np.random.RandomState(7), buckets=2,
+                          client_multiple=4)
+    setup = shard_setup(setup, make_mesh(4))
+    res = FedAvg(setup, lr=0.5, epoch=1, batch_size=16, round=2, seed=0,
+                 lr_mode="constant")
+    res2 = FedAMW(setup, lr=0.5, epoch=1, batch_size=16, round=2,
+                  lambda_reg=1e-4, lr_p=1e-3, seed=0, lr_mode="constant")
+    got = dict(part.split("=") for part in lines[0].split())
+    np.testing.assert_allclose(float(got["fedavg"]),
+                               float(res["test_acc"][-1]), atol=1e-4)
+    np.testing.assert_allclose(float(got["fedamw"]),
+                               float(res2["test_acc"][-1]), atol=1e-4)
