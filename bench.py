@@ -186,10 +186,7 @@ def _bench_reference(ds, D, rounds, algorithm, epoch, batch_size, lr,
     rt.device = torch.device("cpu")
 
     if setup is None:
-        from fedamw_tpu.backends import torch_ref
-
-        setup = torch_ref.prepare_setup(ds, D=D, kernel_par=0.1, seed=100,
-                                        rng=np.random.RandomState(100))
+        setup = make_torch_setup(ds, D)
     J = setup.num_clients
     torch.manual_seed(100)
     X_train = [setup.X[p] for p in setup.parts]

@@ -54,6 +54,7 @@ def test_literal_trialcommand_executes_and_reports(tmp_path):
     reported = float(report.read_text())
     assert 0.0 <= reported <= 100.0
     assert f"acc={reported:.5f}" in out.stdout
-    # the sampled tuner values reached the merged-params dict
-    assert str(tuner_params["lr_p"]) in out.stdout
-    assert str(tuner_params["lambda_reg"]) in out.stdout
+    # the sampled tuner values reached the merged-params dict (keyed
+    # form: a bare value substring could match another flag's default)
+    assert f"'lr_p': {tuner_params['lr_p']}" in out.stdout
+    assert f"'lambda_reg': {tuner_params['lambda_reg']}" in out.stdout
