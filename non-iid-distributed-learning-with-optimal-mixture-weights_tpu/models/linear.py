@@ -51,32 +51,53 @@ def linear_model() -> Model:
     return Model(name="linear", init=_linear_init, apply=_linear_apply)
 
 
-def mlp_model(hidden: int = 64) -> Model:
-    """A true 2-layer MLP (hidden ReLU layer, biasless output).
+def mlp_model(hidden=64) -> Model:
+    """A true MLP (ReLU hidden layers, biasless output).
 
     Not in the reference (its 'MLP' is linear); needed for the scale
     config "covtype 2-layer MLP, 1024 clients" (BASELINE.md).
+    ``hidden`` is one width (int) or a sequence of widths for deeper
+    stacks; every model here is a plain pytree and aggregation/
+    checkpointing/the FedAMW logit stack are pytree-generic, so any
+    depth federates unchanged.
     """
+    widths = (hidden,) if isinstance(hidden, int) else tuple(hidden)
+    if not widths or any(w <= 0 for w in widths):
+        raise ValueError(f"hidden widths must be positive, got {widths}")
 
     def init(key, d, num_classes):
-        k1, k2 = jax.random.split(key)
-        return {
-            "w1": xavier_uniform(k1, (hidden, d)),
-            "b1": jnp.zeros((hidden,), jnp.float32),
-            "w2": xavier_uniform(k2, (num_classes, hidden)),
-        }
+        keys = jax.random.split(key, len(widths) + 1)
+        params = {}
+        fan_in = d
+        for i, (k, w) in enumerate(zip(keys, widths), start=1):
+            params[f"w{i}"] = xavier_uniform(k, (w, fan_in))
+            params[f"b{i}"] = jnp.zeros((w,), jnp.float32)
+            fan_in = w
+        params[f"w{len(widths) + 1}"] = xavier_uniform(
+            keys[-1], (num_classes, fan_in))
+        return params
 
     def apply(params, x):
-        h = jax.nn.relu(x @ params["w1"].T + params["b1"])
-        return h @ params["w2"].T
+        h = x
+        for i in range(1, len(widths) + 1):
+            h = jax.nn.relu(h @ params[f"w{i}"].T + params[f"b{i}"])
+        return h @ params[f"w{len(widths) + 1}"].T
 
-    return Model(name=f"mlp{hidden}", init=init, apply=apply)
+    return Model(name="mlp" + "x".join(str(w) for w in widths),
+                 init=init, apply=apply)
 
 
 def get_model(name: str, **kwargs) -> Model:
+    """``"linear"``, ``"mlp"`` (default width 64), ``"mlp128"``, or a
+    deeper ``"mlp128x64"`` (x-separated hidden widths)."""
     if name == "linear":
         return linear_model()
     if name.startswith("mlp"):
-        hidden = int(name[3:]) if len(name) > 3 else kwargs.pop("hidden", 64)
+        spec = name[3:]
+        if spec:
+            hidden = tuple(int(w) for w in spec.split("x"))
+            hidden = hidden[0] if len(hidden) == 1 else hidden
+        else:
+            hidden = kwargs.pop("hidden", 64)
         return mlp_model(hidden)
     raise ValueError(f"unknown model: {name}")

@@ -2,38 +2,39 @@
 # One-shot TPU-window harvest: run everything that needs the real chip,
 # in priority order, saving all artifacts — so a short tunnel window is
 # never wasted. Usage: bash tpu_window.sh [outdir]
-# Priority: bench first (the driver's headline evidence), then Pallas
-# hardware validation, then the scale configs. Each step is
-# independently time-capped so one wedged compile cannot eat the window.
+# Priority: bench first (the driver's headline evidence; its
+# bench_jax_best already auto-times the XLA vs Pallas legs on TPU and
+# keeps the faster one with an accuracy cross-check — do NOT force
+# FEDAMW_KERNEL/FEDAMW_PSOLVER here, that would make the "xla" leg run
+# pallas too and blind the cross-check), then the Pallas hardware test
+# tier, then the scale configs. Each step is independently time-capped,
+# and the cheap probe re-runs between steps so a mid-window tunnel
+# wedge (the known crashed-Mosaic-compile failure mode) aborts in 120 s
+# instead of burning every remaining step's full cap.
 set -u
 OUT=${1:-tpu_artifacts}
 mkdir -p "$OUT"
 stamp() { date -u +%H:%M:%S; }
+probe() {
+  timeout 120 python -c "import numpy, jax.numpy as jnp; numpy.asarray(jnp.ones(2)+1); print('TUNNEL_UP')" \
+    || { echo "[$(stamp)] tunnel down; stopping (artifacts so far in $OUT/)"; exit 1; }
+}
 
-echo "[$(stamp)] probe"
-timeout 120 python -c "import numpy, jax.numpy as jnp; numpy.asarray(jnp.ones(2)+1); print('TUNNEL_UP')" \
-  || { echo "tunnel down; aborting"; exit 1; }
+echo "[$(stamp)] probe"; probe
 
-echo "[$(stamp)] 1/4 bench.py (headline)"
+echo "[$(stamp)] 1/3 bench.py (headline; auto xla-vs-pallas)"
 timeout 1200 python bench.py >"$OUT/bench.json" 2>"$OUT/bench.log"
 echo "rc=$? bench"; tail -2 "$OUT/bench.json" 2>/dev/null
 
-echo "[$(stamp)] 2/4 pallas hardware tier"
+echo "[$(stamp)] probe"; probe
+echo "[$(stamp)] 2/3 pallas hardware tier"
 FEDAMW_TEST_PLATFORM=tpu timeout 1200 python -m pytest tests/test_pallas_tpu.py -q \
   >"$OUT/pallas.log" 2>&1
-PALLAS_RC=$?
-echo "rc=$PALLAS_RC pallas"; tail -3 "$OUT/pallas.log"
+echo "rc=$? pallas"; tail -3 "$OUT/pallas.log"
 
-echo "[$(stamp)] 3/4 scale_bench.py"
+echo "[$(stamp)] probe"; probe
+echo "[$(stamp)] 3/3 scale_bench.py"
 timeout 1800 python scale_bench.py >"$OUT/scale.json" 2>"$OUT/scale.log"
 echo "rc=$? scale"; tail -2 "$OUT/scale.json" 2>/dev/null
 
-echo "[$(stamp)] 4/4 bench with pallas legs explicitly (if tier passed)"
-if [ "$PALLAS_RC" -eq 0 ]; then
-  FEDAMW_KERNEL=pallas FEDAMW_PSOLVER=pallas timeout 1200 python bench.py \
-    >"$OUT/bench_pallas.json" 2>"$OUT/bench_pallas.log"
-  echo "rc=$? bench_pallas"; tail -2 "$OUT/bench_pallas.json" 2>/dev/null
-else
-  echo "pallas tier not green; skipping forced-pallas bench"
-fi
 echo "[$(stamp)] done -> $OUT/"
