@@ -32,6 +32,11 @@ north star; the repo-torch ratio is still reported as
 ``vs_torch_backend``. Without the checkout, ``vs_baseline`` falls back
 to the repo-torch arm (conservative: it is faster than the reference).
 
+When the accelerator is unreachable (wedged remote tunnel), the bench
+falls back to CPU instead of aborting metric-less: every JSON line
+carries a "platform" field, so a CPU-vs-CPU capture is clearly labeled
+(BENCH_STRICT_TPU=1 restores the hard abort).
+
 Env overrides: BENCH_CLIENTS (default 256), BENCH_ROUNDS (default 20),
 BENCH_D (default 2000), BENCH_TORCH_ROUNDS (default 2), BENCH_BUCKETS
 (default 32), BENCH_AMW_TORCH_ROUNDS (default 2), BENCH_REF_ROUNDS /
@@ -257,15 +262,26 @@ def main():
                 [_sys.executable, "-c", "import numpy, jax.numpy as jnp; numpy.asarray(jnp.ones(2) + 1)"],
                 timeout=180, capture_output=True, check=True, text=True,
             )
-        except subprocess.TimeoutExpired:
-            print("# bench aborted: device backend unreachable (remote "
-                  "tunnel down?) — no metrics emitted rather than a "
-                  "hang", file=sys.stderr)
-            raise SystemExit(1)
-        except subprocess.CalledProcessError as e:
-            print(f"# bench aborted: device probe failed: "
-                  f"{e.stderr[-500:]}", file=sys.stderr)
-            raise SystemExit(1)
+        except (subprocess.TimeoutExpired,
+                subprocess.CalledProcessError) as e:
+            # The accelerator is unreachable (wedged remote tunnel) or
+            # broken. Historically this aborted with no metrics
+            # (BENCH_r02 null); a clearly-labeled CPU measurement is
+            # strictly more information — the JAX-vs-baseline ratio on
+            # the same host CPU is still a true statement about the
+            # framework (set BENCH_STRICT_TPU=1 to restore the abort).
+            detail = (f"probe failed: {e.stderr[-300:]}"
+                      if isinstance(e, subprocess.CalledProcessError)
+                      else "device backend unreachable (tunnel down?)")
+            if os.environ.get("BENCH_STRICT_TPU"):
+                print(f"# bench aborted: {detail}", file=sys.stderr)
+                raise SystemExit(1)
+            print(f"# accelerator {detail}; falling back to CPU — "
+                  "metrics below are CPU-vs-CPU and labeled "
+                  'platform="cpu"', file=sys.stderr)
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
     num_clients = int(os.environ.get("BENCH_CLIENTS", "256"))
     rounds = int(os.environ.get("BENCH_ROUNDS", "20"))
     D = int(os.environ.get("BENCH_D", "2000"))
@@ -273,6 +289,9 @@ def main():
     amw_torch_rounds = int(os.environ.get("BENCH_AMW_TORCH_ROUNDS", "2"))
 
     ds = build_dataset(num_clients)
+    import jax
+
+    platform = jax.default_backend()
 
     jax_ups, jax_acc, jax_dt, jax_impl = bench_jax_best(ds, D, rounds)
     tsetup = make_torch_setup(ds, D)
@@ -307,6 +326,7 @@ def main():
         "baseline_arm": base_arm,
         "vs_torch_backend": round(jax_ups / torch_ups, 2),
         "impl": jax_impl,
+        "platform": platform,
     }
     if ref is not None:
         headline["vs_reference_loop"] = round(jax_ups / ref[0], 2)
@@ -343,6 +363,7 @@ def main():
             "baseline_arm": amw_base_arm,
             "vs_torch_backend": round(amw_ups / amw_t_ups, 2),
             "impl": amw_impl,
+            "platform": platform,
         }
         if amw_ref is not None:
             amw_line["vs_reference_loop"] = round(amw_ups / amw_ref[0], 2)

@@ -23,7 +23,10 @@ probe() {
 echo "[$(stamp)] probe"; probe
 
 echo "[$(stamp)] 1/3 bench.py (headline; auto xla-vs-pallas)"
-timeout 1200 python bench.py >"$OUT/bench.json" 2>"$OUT/bench.log"
+# STRICT: this script exists to harvest REAL-chip numbers; if the
+# tunnel dies mid-step, abort fast (bench.py's default CPU fallback is
+# for the driver's unattended capture, not for this window)
+BENCH_STRICT_TPU=1 timeout 1200 python bench.py >"$OUT/bench.json" 2>"$OUT/bench.log"
 echo "rc=$? bench"; tail -2 "$OUT/bench.json" 2>/dev/null
 
 echo "[$(stamp)] probe"; probe
