@@ -57,14 +57,32 @@ def _load_oracle():
     has top-level ``exp.py``/``tune.py`` that would otherwise shadow
     this repo's same-named modules for the rest of the process (e.g. a
     later in-process ``import tune`` would hit the reference's, which
-    unconditionally imports NNI).
+    unconditionally imports NNI). The reference's module-global device
+    is pinned to CPU (``tools.py:12`` selects CUDA when available; every
+    consumer here compares CPU-to-CPU on CPU tensors).
     """
+    import torch
+
     sys.path.insert(0, REFERENCE_ROOT)
     try:
         import functions.tools as reference_tools
     finally:
         sys.path.remove(REFERENCE_ROOT)
+    reference_tools.device = torch.device("cpu")
     return reference_tools
+
+
+def reference_inputs(setup, val_batch_size=16):
+    """A repo ``TorchSetup``'s tensors in the reference's calling
+    convention: per-client tensor lists + the pooled shuffled val
+    loader (reference ``exp.py:78-99``, batch 16)."""
+    from torch.utils.data import DataLoader, TensorDataset
+
+    X_train = [setup.X[p] for p in setup.parts]
+    y_train = [setup.y[p] for p in setup.parts]
+    validloader = DataLoader(TensorDataset(setup.X_val, setup.y_val),
+                             batch_size=val_batch_size, shuffle=True)
+    return X_train, y_train, validloader
 
 
 def _final(res):
@@ -75,21 +93,13 @@ def run_oracle(setup, rounds, seed):
     """Run all seven reference algorithms (tools.py:240-463) on the
     repo-produced tensors. Returns {algo: final_test_acc}."""
     import torch
-    from torch.utils.data import DataLoader, TensorDataset
 
     rt = _load_oracle()
-    # the reference pins its module-global device to CUDA when available
-    # (tools.py:12); this harness compares CPU-to-CPU on CPU tensors
-    rt.device = torch.device("cpu")
     torch.manual_seed(seed)
-    X_train = [setup.X[p] for p in setup.parts]
-    y_train = [setup.y[p] for p in setup.parts]
+    X_train, y_train, validloader = reference_inputs(setup)
     kw = dict(X_test=setup.X_test, y_test=setup.y_test, type=setup.task,
               num_classes=setup.num_classes, D=setup.D,
               batch_size=ANCHOR["batch_size"])
-    # reference pooled val loader: batch 16, shuffled (exp.py:99)
-    validloader = DataLoader(TensorDataset(setup.X_val, setup.y_val),
-                             batch_size=16, shuffle=True)
     lr, ep = ANCHOR["lr"], ANCHOR["epoch"]
     out = {}
     sink = io.StringIO()  # test_loop prints every call (tools.py:236)

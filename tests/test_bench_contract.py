@@ -1,0 +1,65 @@
+"""The driver contract of bench.py and __graft_entry__.py.
+
+The round driver consumes bench.py's stdout (JSON lines, headline
+metric LAST) and runs ``dryrun_multichip`` for the multi-chip
+correctness artifact — both must keep working regardless of refactors,
+and both must survive an unreachable accelerator (the remote-tunnel
+outage that nulled the round-2 artifacts). Tiny shapes keep this
+test-sized; the compile cache (conftest) makes reruns cheap.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_emits_driver_contract_json():
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_CLIENTS="8", BENCH_ROUNDS="2", BENCH_D="64",
+        BENCH_TORCH_ROUNDS="1", BENCH_AMW_TORCH_ROUNDS="1",
+        BENCH_REF_ROUNDS="1", BENCH_AMW_REF_ROUNDS="1",
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=570,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [json.loads(ln) for ln in out.stdout.splitlines() if ln]
+    assert len(lines) == 2
+    # headline LAST (the driver records the final line)
+    assert lines[-1]["metric"] == "client_updates_per_sec"
+    assert lines[0]["metric"] == "fedamw_client_updates_per_sec"
+    for rec in lines:
+        assert rec["unit"] == "client-updates/s"
+        assert rec["value"] > 0
+        assert rec["vs_baseline"] > 0
+        assert rec["platform"] == "cpu"
+        assert rec["baseline_arm"] in ("reference-loop", "torch-backend")
+        assert rec["impl"] in ("xla", "pallas")
+
+
+def test_dryrun_multichip_succeeds_without_backend_query():
+    """`python -c "import __graft_entry__ as g; g.dryrun_multichip(4)"`
+    completes via the respawn-first path (no respawn-skip vars set).
+    What this pins is the mechanics — the parent must reach the respawn
+    without needing a JAX backend query, and the child must pin the
+    virtual CPU mesh. The hang scenario itself (parent backend query
+    blocking on this container's force-registered remote plugin with
+    the tunnel down — MULTICHIP_r02 rc=124) only manifests under that
+    sitecustomize, so it is covered by construction, not simulated
+    here."""
+    env = dict(os.environ)
+    env.pop("_GRAFT_DRYRUN_RESPAWNED", None)
+    env.pop("GRAFT_DRYRUN_REAL", None)
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(4)"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=570,
+    )
+    assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
+    assert "dryrun_multichip(4): OK" in out.stdout
