@@ -24,11 +24,17 @@ if os.environ.get("FEDAMW_TEST_PLATFORM", "cpu") == "cpu":
     # Persistent compilation cache: the suite is dominated by jit
     # compiles of the fused round-scan programs (20s+ each for the mesh
     # tests), which are identical run to run. Warm runs load them from
-    # disk instead of recompiling.
-    jax.config.update(
-        "jax_compilation_cache_dir",
+    # disk instead of recompiling. Exported via env (not just
+    # config.update) so subprocess-based tests — bench contract, the
+    # dryrun respawn, multihost children, the NNI trial — inherit it.
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      ".jax_cache"),
+    )
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ["JAX_COMPILATION_CACHE_DIR"],
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 else:

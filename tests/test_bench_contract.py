@@ -5,7 +5,9 @@ metric LAST) and runs ``dryrun_multichip`` for the multi-chip
 correctness artifact — both must keep working regardless of refactors,
 and both must survive an unreachable accelerator (the remote-tunnel
 outage that nulled the round-2 artifacts). Tiny shapes keep this
-test-sized; the compile cache (conftest) makes reruns cheap.
+test-sized; the persistent compile cache reaches the subprocesses via
+the JAX_COMPILATION_CACHE_DIR env var conftest exports, so reruns are
+cheap.
 """
 
 import json

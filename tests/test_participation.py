@@ -56,6 +56,20 @@ def test_partial_participation_runs_and_differs(setup8):
     assert half["test_acc"][-1] > 30.0  # still learns
 
 
+def test_fednova_partial_participation(setup8):
+    """FedNova composes with participation through the shared round
+    skeleton: the tau-scaled weights renormalize over the participating
+    subset (mass-preserving, like FedAvg's)."""
+    kw = dict(lr=0.5, epoch=1, round=4, seed=0, lr_mode="constant")
+    from fedamw_tpu.algorithms import FedNova
+
+    full = FedNova(setup8, **kw)
+    half = FedNova(setup8, participation=0.5, **kw)
+    assert np.all(np.isfinite(np.asarray(half["test_loss"])))
+    assert not np.allclose(full["train_loss"], half["train_loss"])
+    assert half["test_acc"][-1] > 30.0
+
+
 def test_fedamw_rejects_partial_participation(setup8):
     with pytest.raises(ValueError, match="full participation"):
         FedAMW(setup8, participation=0.5, round=2)
