@@ -32,11 +32,16 @@ if os.environ.get("FEDAMW_TEST_PLATFORM", "cpu") == "cpu":
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
                      ".jax_cache"),
     )
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "0.5")
     jax.config.update(
         "jax_compilation_cache_dir",
         os.environ["JAX_COMPILATION_CACHE_DIR"],
     )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]),
+    )
 else:
     # FEDAMW_TEST_PLATFORM=tpu: leave the real backend in place so the
     # hardware-validation tests (tests/test_pallas_tpu.py) run against

@@ -46,13 +46,16 @@ def _epoch_kernel(
     a_ref,        # (C, D) prox anchor: the client's ROUND-incoming params
                   # (tools.py:180) — differs from w0 after the 1st epoch
     x_ref,        # (1, B, D) this step's batch features
-    y_ref,        # (1, 1, B) labels (int32 classification / f32 regression)
-                  #   — the singleton middle axis keeps the block's last
-                  #   two dims equal to the array's (Mosaic requires
-                  #   last-two block dims divisible by (8, 128) or equal
-                  #   to the array dims; a (1, B) block over an (S, B)
-                  #   array satisfies neither)
-    bv_ref,       # (1, 1, B) batch-validity mask (same layout)
+    y_ref,        # (1, B, 1) labels (int32 classification / f32
+                  #   regression), column layout — the trailing singleton
+                  #   keeps the block's last two dims equal to the
+                  #   array's (Mosaic requires last-two block dims
+                  #   divisible by (8, 128) or equal to the array dims; a
+                  #   (1, B) block over an (S, B) array satisfies
+                  #   neither), and the column shape keeps every reduced
+                  #   tensor 2-D (1-D (B,)-shaped chains fail to lower —
+                  #   "Offset change"; same layout as pallas_psolver.py)
+    bv_ref,       # (1, B, 1) batch-validity mask (same layout)
     scal_ref,     # (3,) SMEM: lr, mu, lam
     w_out_ref,    # (C, D) final weights
     met_ref,      # (1, 3) loss*cnt sum, correct sum, cnt sum
@@ -72,42 +75,44 @@ def _epoch_kernel(
     w = w_ref[:]
     anchor = a_ref[:]
     xb = x_ref[0]                      # (B, D)
-    bv = bv_ref[0, 0].astype(jnp.float32)  # (B,)
+    bvc = bv_ref[0].astype(jnp.float32)  # (B, 1) column
     lr, mu, lam = scal_ref[0], scal_ref[1], scal_ref[2]
 
-    cnt = jnp.sum(bv)
+    cnt = jnp.sum(bvc)
     inv_cnt = 1.0 / jnp.maximum(cnt, 1.0)
     z = jnp.dot(xb, w.T, preferred_element_type=jnp.float32)  # (B, C)
 
+    # every reduced tensor stays 2-D ((B, 1) columns / (B, C) blocks):
+    # Mosaic cannot lower 1-D (B,)-shaped compare/sum chains ("Offset
+    # change") — same discipline as pallas_psolver.py
     if task_is_classification:
-        y = y_ref[0, 0]                # (B,) int32
+        yc = y_ref[0]                  # (B, 1) int32
         zmax = jnp.max(z, axis=-1, keepdims=True)
         ez = jnp.exp(z - zmax)
         Z = jnp.sum(ez, axis=-1, keepdims=True)
         softmax = ez / Z
         onehot = (
-            jax.lax.broadcasted_iota(jnp.int32, (B, C), 1) == y[:, None]
+            jax.lax.broadcasted_iota(jnp.int32, (B, C), 1) == yc
         ).astype(jnp.float32)
-        # CE per example: logsumexp - z[label]
-        per = (jnp.log(Z[:, 0]) + zmax[:, 0]) - jnp.sum(z * onehot, axis=-1)
-        dz = (softmax - onehot) * (bv * inv_cnt)[:, None]   # (B, C)
-        # top-1 correctness as a fully 2-D reduction: Mosaic cannot yet
-        # lower the 1-D (B,)-shaped compare/sum chain ("Offset change"),
-        # so compare the keepdims argmax against a 2-D iota and reduce
-        # the (B, C) product in one shot.
+        # CE per example: logsumexp - z[label], kept as a (B, 1) column
+        per = (jnp.log(Z) + zmax) - jnp.sum(z * onehot, axis=-1,
+                                            keepdims=True)
+        dz = (softmax - onehot) * (bvc * inv_cnt)           # (B, C)
+        # top-1 correctness via keepdims argmax against a 2-D iota,
+        # reduced as one (B, C) product
         pred = jnp.argmax(z, axis=-1, keepdims=True)        # (B, 1)
         first_max = (
             jax.lax.broadcasted_iota(jnp.int32, (B, C), 1) == pred
         ).astype(jnp.float32)
-        correct = jnp.sum(first_max * onehot * bv[:, None])
+        correct = jnp.sum(first_max * onehot * bvc)
     else:
-        y = y_ref[0, 0].astype(jnp.float32)
-        err = z - y[:, None]           # (B, C); mean over C per example
-        per = jnp.mean(jnp.square(err), axis=-1)
-        dz = err * (2.0 / C) * (bv * inv_cnt)[:, None]
+        yc = y_ref[0].astype(jnp.float32)                   # (B, 1)
+        err = z - yc                   # (B, C); mean over C per example
+        per = jnp.sum(jnp.square(err), axis=-1, keepdims=True) / C
+        dz = err * (2.0 / C) * (bvc * inv_cnt)
         correct = 0.0
 
-    data_loss = jnp.sum(per * bv) * inv_cnt
+    data_loss = jnp.sum(per * bvc) * inv_cnt
     grad = jnp.dot(dz.T, xb, preferred_element_type=jnp.float32)  # (C, D)
 
     # unsquared norms, grad 0 at 0 (ops/losses.py:l2_norm_safe)
@@ -161,9 +166,9 @@ def make_pallas_epoch(task: str, C: int, D: int, B: int, S: int,
                              memory_space=pltpu.VMEM),
                 pl.BlockSpec((1, B, D), lambda s: (s, 0, 0),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, 1, B), lambda s: (s, 0, 0),
+                pl.BlockSpec((1, B, 1), lambda s: (s, 0, 0),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((1, 1, B), lambda s: (s, 0, 0),
+                pl.BlockSpec((1, B, 1), lambda s: (s, 0, 0),
                              memory_space=pltpu.VMEM),
                 pl.BlockSpec(memory_space=pltpu.SMEM),
             ],
@@ -182,8 +187,8 @@ def make_pallas_epoch(task: str, C: int, D: int, B: int, S: int,
                 pltpu.SMEM((3,), jnp.float32),
             ],
             interpret=interpret,
-        )(w0, anchor, Xe, ye.astype(y_dtype)[:, None, :],
-          bv[:, None, :], scal)
+        )(w0, anchor, Xe, ye.astype(y_dtype)[..., None],
+          bv[..., None], scal)
         return w, met[0]
 
     return epoch

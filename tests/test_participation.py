@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from fedamw_tpu.algorithms import FedAMW, FedAvg, prepare_setup
+from fedamw_tpu.algorithms import FedAMW, FedAvg, FedNova, prepare_setup
 from fedamw_tpu.backends import torch_ref
 from fedamw_tpu.data import load_dataset
 from fedamw_tpu.fedcore import participation_weights
@@ -61,8 +61,6 @@ def test_fednova_partial_participation(setup8):
     skeleton: the tau-scaled weights renormalize over the participating
     subset (mass-preserving, like FedAvg's)."""
     kw = dict(lr=0.5, epoch=1, round=4, seed=0, lr_mode="constant")
-    from fedamw_tpu.algorithms import FedNova
-
     full = FedNova(setup8, **kw)
     half = FedNova(setup8, participation=0.5, **kw)
     assert np.all(np.isfinite(np.asarray(half["test_loss"])))
