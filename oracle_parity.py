@@ -285,6 +285,69 @@ def render(summary):
     return "\n".join(lines), all_ok
 
 
+def degenerate_check(rounds=30, seed=100):
+    """The exp.py-defaults anchor (digits, J=50, alpha=0.01, D=2000)
+    where PARITY.md §2's FedAvg/FedProx rows sit flat at 8.61: run the
+    REFERENCE's own FedAvg there, plus both repo backends in sequential
+    and parallel modes, to pin which semantics owns the degeneracy.
+
+    Oracle-verified conclusion (also printed): the flat rows belong to
+    the PARALLEL form — the paper's described algorithm and the repo
+    default, where the one-class client updates average out — while the
+    reference's sequential-contamination artifact (one model chained
+    through clients, tools.py:341) lets its code partially escape;
+    ``sequential=True`` reproduces that escape on both backends.
+    """
+    import torch
+
+    from fedamw_tpu.data import load_dataset
+    from fedamw_tpu.registry import get_backend
+
+    point = dict(dataset="digits", J=50, alpha=0.01, D=2000,
+                 kernel_par=0.1, lr=0.5, epoch=2, batch_size=32)
+    out = {"anchor": {**point, "round": rounds, "seed": seed}}
+
+    from fedamw_tpu.backends import torch_ref
+
+    rng = np.random.RandomState(seed)
+    ds = load_dataset(point["dataset"], point["J"], point["alpha"],
+                      rng=rng)
+    tsetup = torch_ref.prepare_setup(ds, D=point["D"],
+                                     kernel_par=point["kernel_par"],
+                                     seed=seed, rng=rng)
+    rt = _load_oracle()
+    torch.manual_seed(seed)
+    X_train, y_train, _ = reference_inputs(tsetup)
+    with contextlib.redirect_stdout(io.StringIO()):
+        _, _, acc = rt.FedAvg(
+            X_train, y_train, X_test=tsetup.X_test, y_test=tsetup.y_test,
+            type="classification", num_classes=tsetup.num_classes,
+            D=point["D"], lr=point["lr"], epoch=point["epoch"],
+            batch_size=point["batch_size"], round=rounds)
+    a = np.asarray(acc)
+    out["reference"] = {"first": float(a[0]), "last": float(a[-1])}
+
+    for backend in ("jax", "torch"):
+        be = get_backend(backend)
+        for sequential in (True, False):
+            rng = np.random.RandomState(seed)
+            ds = load_dataset(point["dataset"], point["J"],
+                              point["alpha"], rng=rng)
+            setup = be.prepare_setup(ds, D=point["D"],
+                                     kernel_par=point["kernel_par"],
+                                     seed=seed, rng=rng)
+            res = be.ALGORITHMS["FedAvg"](
+                setup, lr=point["lr"], epoch=point["epoch"],
+                batch_size=point["batch_size"], round=rounds, seed=seed,
+                sequential=sequential)
+            acc = np.asarray(res["test_acc"])
+            out[f"{backend}_{'seq' if sequential else 'par'}"] = {
+                "first": float(acc[0]), "last": float(acc[-1]),
+                "ptp": float(np.ptp(acc)),
+            }
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seeds", type=int, default=5)
@@ -295,17 +358,31 @@ def main():
     ap.add_argument("--render", type=str, default=None, metavar="JSON",
                     help="render markdown from an existing summary "
                          "instead of running")
+    ap.add_argument("--degenerate-check", action="store_true",
+                    help="run the exp.py-defaults degeneracy attribution "
+                         "check (see degenerate_check), print JSON, and "
+                         "write the artifact to --degen-out")
+    ap.add_argument("--degen-out", type=str,
+                    default="results_parity/degenerate_check.json")
     args = ap.parse_args()
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    if args.degenerate_check:
+        out = degenerate_check(args.round, args.seed0)
+        os.makedirs(os.path.dirname(args.degen_out) or ".", exist_ok=True)
+        with open(args.degen_out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps(out, indent=1))
+        print(f"artifact -> {args.degen_out}", file=sys.stderr)
+        return 0
     if args.render:
         with open(args.render) as f:
             summary = json.load(f)
         text, ok = render(summary)
         print(text)
         return 0 if ok else 1
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
-
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     summary = collect(range(args.seed0, args.seed0 + args.seeds),
                       args.round, args.out)
     text, ok = render(summary)

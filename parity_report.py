@@ -115,7 +115,15 @@ def main(jax_pkl, torch_pkl):
               "non-IID failure mode the paper's FedAMW targets — "
               "compare the FedAMW row on the same partitions — "
               "reproduced identically by both backends, not a numerical "
-              "artifact.")
+              "artifact. Attribution: the degeneracy belongs to the "
+              "PARALLEL client semantics both backends default to (the "
+              "paper's described form); the reference's own loop "
+              "partially escapes it through its sequential "
+              "client-contamination artifact (`tools.py:341`), and "
+              "`sequential=True` reproduces that escape on both "
+              "backends — oracle-verified by "
+              "`oracle_parity.py --degenerate-check` (numbers in "
+              "PARITY.md's degeneracy-attribution note).")
         print()
     print(f"Overall: {'ALL SIX ALGORITHMS IN PARITY' if ok else 'PARITY FAILURES — see table'}.")
     print()
