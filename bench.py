@@ -193,20 +193,30 @@ def _bench_reference(ds, D, rounds, algorithm, epoch, batch_size, lr,
     if setup is None:
         setup = make_torch_setup(ds, D)
     J = setup.num_clients
-    torch.manual_seed(100)
-    X_train, y_train, validloader = reference_inputs(setup)
-    kw = dict(X_test=setup.X_test, y_test=setup.y_test, type=setup.task,
-              num_classes=setup.num_classes, D=setup.D, lr=lr,
-              epoch=epoch, batch_size=batch_size)
-    if algorithm == "FedAMW":
-        kw["validloader"] = validloader
-    fn = getattr(rt, algorithm)
-    sink = io.StringIO()  # test_loop prints per round (tools.py:236)
-    with contextlib.redirect_stdout(sink):
-        fn(X_train, y_train, round=1, **kw)  # steady-state warmup
-        t0 = time.perf_counter()
-        _, _, acc = fn(X_train, y_train, round=rounds, **kw)
-        dt = time.perf_counter() - t0
+    # fork_rng: seeding scoped to this arm, so adding/removing the
+    # reference leg does not perturb the other torch arms' shuffle
+    # streams (r3 advisor: legs must not be order-dependent)
+    with torch.random.fork_rng():
+        torch.manual_seed(100)
+        X_train, y_train, validloader = reference_inputs(setup)
+        y_test = setup.y_test
+        if setup.task != "classification":
+            # match reference_inputs' (n, 1) regression labels — a flat
+            # y_test against the reference model's (n, 1) output would
+            # make nn.MSELoss broadcast to (n, n)
+            y_test = y_test.reshape(-1, 1)
+        kw = dict(X_test=setup.X_test, y_test=y_test,
+                  type=setup.task, num_classes=setup.num_classes,
+                  D=setup.D, lr=lr, epoch=epoch, batch_size=batch_size)
+        if algorithm == "FedAMW":
+            kw["validloader"] = validloader
+        fn = getattr(rt, algorithm)
+        sink = io.StringIO()  # test_loop prints per round (tools.py:236)
+        with contextlib.redirect_stdout(sink):
+            fn(X_train, y_train, round=1, **kw)  # steady-state warmup
+            t0 = time.perf_counter()
+            _, _, acc = fn(X_train, y_train, round=rounds, **kw)
+            dt = time.perf_counter() - t0
     return J * rounds / dt, float(np.asarray(acc).reshape(-1)[-1]), dt
 
 
@@ -310,7 +320,22 @@ def main():
         file=sys.stderr,
     )
     ref_rounds = int(os.environ.get("BENCH_REF_ROUNDS", "2"))
-    ref = bench_reference(ds, D, ref_rounds, setup=tsetup)
+    # In an unattended CPU fallback the reference arm (a warmup round +
+    # ref_rounds of the reference's sequential loop over all clients)
+    # would dominate wall-clock and delay the very headline line the
+    # fallback trim protects (r3 advisor) — skip it unless explicitly
+    # kept; vs_baseline then uses the torch-backend denominator, which
+    # baseline_arm labels (and is conservative: the repo's torch backend
+    # is faster than the reference's loop).
+    skip_ref = (cpu_fallback
+                and not os.environ.get("BENCH_CPU_FALLBACK_FULL")
+                and "BENCH_REF_ROUNDS" not in os.environ)
+    if skip_ref:
+        print("# reference arm skipped in CPU fallback (headline "
+              "first); set BENCH_CPU_FALLBACK_FULL=1 or BENCH_REF_ROUNDS "
+              "to keep it", file=sys.stderr)
+    ref = None if skip_ref else bench_reference(ds, D, ref_rounds,
+                                                setup=tsetup)
     if ref is not None:
         print(
             f"# FedAvg  reference-loop: {ref[0]:.1f} updates/s "

@@ -610,6 +610,20 @@ def _round_based(
                     f"server_opt={server_opt!r}; resume with the same "
                     f"server optimizer (or drop 'server_opt' from the "
                     f"checkpoint to restart the optimizer)")
+            if opt_key == "server_opt" and saved_kind is None:
+                # a hand-assembled resume dict without the tag defeats
+                # the drift guard above (adam/yogi share a leaf
+                # structure, so a cross-optimizer resume would silently
+                # reinterpret one's moments as the other's) — warn so
+                # the untagged flow is at least not silent (r3 advisor)
+                warnings.warn(
+                    "resuming with 'server_opt' state but no "
+                    "'server_opt_kind' tag: cannot verify the state was "
+                    f"produced by server_opt={server_opt!r} (adam/yogi "
+                    "states are structurally interchangeable); carry "
+                    "res['server_opt_kind'] through the checkpoint to "
+                    "make cross-optimizer drift detectable",
+                    stacklevel=3)
             opt0 = tuple(jnp.asarray(x) for x in resume_from[opt_key])
         if aggregation == "learned":
             if resume_from.get("p") is not None:

@@ -27,16 +27,10 @@ SEED = 100
 def arms():
     # smaller than the PARITY.md anchor so the sequential oracle loop
     # stays test-sized; same digits/alpha=0.5 regime where FedAvg learns
-    overrides = dict(num_partitions=8, D=128)
-    saved = dict(oracle_parity.ANCHOR)
-    oracle_parity.ANCHOR.update(overrides)
-    try:
-        setup = oracle_parity._build_torch_setup(SEED)
-        ref = oracle_parity.run_oracle(setup, ROUNDS, SEED)
-        repo = oracle_parity.run_repo("torch", ROUNDS, SEED)
-    finally:
-        oracle_parity.ANCHOR.clear()
-        oracle_parity.ANCHOR.update(saved)
+    anchor = dict(oracle_parity.ANCHOR, num_partitions=8, D=128)
+    setup = oracle_parity._build_torch_setup(SEED, anchor)
+    ref = oracle_parity.run_oracle(setup, ROUNDS, SEED, anchor)
+    repo = oracle_parity.run_repo("torch", ROUNDS, SEED, anchor=anchor)
     return ref, repo
 
 

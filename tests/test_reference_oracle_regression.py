@@ -1,0 +1,61 @@
+"""The reference's MSE branch as a read-only parity oracle (fast tier).
+
+Companion to ``tests/test_reference_oracle.py`` for the regression task:
+the reference's synthetic regression path (``tune.py:58-66`` →
+``load_synthetic_data``, ``utils.py:74-84``) routes ``train_loop``/
+``test_loop`` through ``nn.MSELoss`` (``tools.py:183-184, 231-234``).
+This pins that branch against the repo's torch backend at a test-sized
+operating point; the 5-seed statistical matrix lives in PARITY.md §3
+(``oracle_parity.py --task regression``). Skips when the reference
+checkout is absent (other machines).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import oracle_parity
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(oracle_parity.REFERENCE_ROOT),
+    reason="reference checkout not mounted",
+)
+
+ROUNDS = 6
+SEED = 100
+
+
+@pytest.fixture(scope="module")
+def arms():
+    # smaller than the PARITY.md §3 anchor so the sequential oracle loop
+    # stays test-sized; same lr=0.2 regime where the oracle genuinely
+    # learns (CL approaches the 0.04 label-noise floor)
+    anchor = dict(oracle_parity.REG_ANCHOR, num_partitions=8, D=128)
+    setup = oracle_parity._build_torch_setup(SEED, anchor)
+    ref = oracle_parity.run_oracle(setup, ROUNDS, SEED, anchor)
+    repo = oracle_parity.run_repo("torch", ROUNDS, SEED, anchor=anchor)
+    return ref, repo
+
+
+def test_oracle_regression_learns(arms):
+    """The reference itself learns at this anchor: MSE drops far below
+    the var(y) ~ 10 predict-zero baseline, and the mixture algorithms
+    beat plain averaging (the paper's headline ordering)."""
+    ref, _ = arms
+    assert set(ref) == set(oracle_parity.ALGOS)
+    assert all(np.isfinite(v) for v in ref.values())
+    assert ref["CL"] < 1.0
+    assert ref["FedAMW"] < ref["FedAvg"]
+
+
+def test_repo_torch_matches_oracle_mse(arms):
+    """Same tensors, same sequential semantics, independent
+    implementations; single seed, so the band covers shuffle/init RNG
+    noise. FedAMW_OneShot gets a wider band for the reference's p[0]^t
+    aliasing bug (tools.py:318-320), deliberately not reproduced."""
+    ref, repo = arms
+    for algo in oracle_parity.ALGOS:
+        band = 1.0 if algo == "FedAMW_OneShot" else 0.5
+        assert abs(ref[algo] - repo[algo]) <= band, (
+            f"{algo}: oracle {ref[algo]:.4f} vs repo {repo[algo]:.4f}")
