@@ -348,6 +348,15 @@ def main():
     # fallback is conservative (it is faster than the reference's loop).
     base_ups, base_arm = ((ref[0], "reference-loop") if ref is not None
                           else (torch_ups, "torch-backend"))
+    # first-principles FLOPs (PERFORMANCE.md § MFU/roofline): bias-free
+    # linear model, fwd GEMM 2·D·C per sample, bwd ≈ 2× fwd, 2 local
+    # epochs over the mean post-val-split (×0.8) client shard — makes
+    # the roofline numbers driver-captured, not hand-derived
+    # mean over ALL J clients (empty shards contribute 0 FLOPs but DO
+    # count as "updates" in updates/s, so excluding them would overstate
+    # achieved FLOP/s by the empty-client fraction)
+    n_mean = 0.8 * float(np.mean([len(p) for p in ds.parts]))
+    flops_upd = 3 * 2 * D * ds.num_classes * 2 * n_mean
     headline = {
         "metric": "client_updates_per_sec",
         "value": round(jax_ups, 2),
@@ -357,6 +366,8 @@ def main():
         "vs_torch_backend": round(jax_ups / torch_ups, 2),
         "impl": jax_impl,
         "platform": platform,
+        "flops_per_update": round(flops_upd),
+        "achieved_gflops": round(jax_ups * flops_upd / 1e9, 2),
     }
     if ref is not None:
         headline["vs_reference_loop"] = round(jax_ups / ref[0], 2)
@@ -367,9 +378,44 @@ def main():
     # reaching the headline line before any driver-side wall-clock cap
     # beats auxiliary evidence (BENCH_CPU_FALLBACK_FULL=1 keeps it).
     if cpu_fallback and not os.environ.get("BENCH_CPU_FALLBACK_FULL"):
-        print("# FedAMW leg skipped in CPU fallback (headline first); "
-              "set BENCH_CPU_FALLBACK_FULL=1 to keep it",
-              file=sys.stderr)
+        # r3 weakness: the paper's own algorithm had NO throughput
+        # datapoint in a fallback artifact. A JAX-only FedAMW leg (no
+        # torch/reference arms — those are the wall-clock killers) is
+        # ~3x the FedAvg leg, so run it when the FedAvg leg was fast
+        # (warm compile cache); BENCH_FALLBACK_AMW=1/0 forces/disables.
+        amw_gate = os.environ.get("BENCH_FALLBACK_AMW")
+        run_amw = (amw_gate == "1" or (amw_gate != "0" and jax_dt < 20.0))
+        if run_amw:
+            # print the headline BEFORE the optional FedAMW leg so a
+            # driver-side wall-clock kill mid-leg still leaves it in the
+            # captured output (the BENCH_r02-null failure mode), then
+            # re-print it LAST because the driver parses the final JSON
+            # line as THE metric — the duplicate is identical content
+            print(json.dumps(headline))
+            try:
+                amw_ups, amw_acc, amw_dt, amw_impl = bench_jax_best(
+                    ds, D, rounds, algorithm="FedAMW")
+                print(f"# FedAMW  jax[{amw_impl}]: {amw_ups:.1f} "
+                      f"updates/s ({rounds} rounds in {amw_dt:.2f}s, acc "
+                      f"{amw_acc:.2f}); baseline arms skipped in CPU "
+                      "fallback", file=sys.stderr)
+                print(json.dumps({
+                    "metric": "fedamw_client_updates_per_sec",
+                    "value": round(amw_ups, 2),
+                    "unit": "client-updates/s",
+                    "impl": amw_impl,
+                    "platform": platform,
+                    "note": "jax-only leg (CPU fallback): baseline arms "
+                            "skipped, no vs_baseline",
+                }))
+            except Exception as e:  # pragma: no cover - defensive
+                print(f"# FedAMW fallback leg failed: {e!r}",
+                      file=sys.stderr)
+        else:
+            print("# FedAMW leg skipped in CPU fallback (FedAvg leg "
+                  f"took {jax_dt:.1f}s — cold cache; headline first); "
+                  "set BENCH_FALLBACK_AMW=1 or BENCH_CPU_FALLBACK_FULL=1 "
+                  "to keep it", file=sys.stderr)
         print(json.dumps(headline))
         return
     try:

@@ -1,0 +1,33 @@
+#!/bin/bash
+# Tunnel watcher: poll the axon TPU probe until it revives, then run the
+# one-shot window harvest (tpu_window.sh) and commit its artifacts —
+# so a short, unattended tunnel window is never wasted (the round-3
+# review: "tpu_window.sh only runs if a human happens to be watching").
+# Usage: bash tpu_watch.sh [outdir]   (env: TPU_WATCH_INTERVAL seconds,
+# default 600; TPU_WATCH_MAX_POLLS caps the loop, default unbounded)
+set -u
+OUT=${1:-tpu_artifacts}
+INTERVAL=${TPU_WATCH_INTERVAL:-600}
+MAX=${TPU_WATCH_MAX_POLLS:-0}
+n=0
+while :; do
+  if timeout 120 python -c \
+      "import numpy, jax.numpy as jnp; numpy.asarray(jnp.ones(2)+1); print('TUNNEL_UP')"; then
+    echo "[$(date -u +%H:%M:%S)] tunnel up — harvesting into $OUT/"
+    bash tpu_window.sh "$OUT"
+    rc=$?
+    # commit whatever landed even on partial harvest (a mid-window
+    # wedge still leaves the earlier steps' artifacts)
+    git add -A "$OUT" 2>/dev/null
+    git commit -m "TPU window harvest: bench/pallas/scale artifacts (rc=$rc)" \
+      -- "$OUT" 2>/dev/null || echo "nothing new to commit"
+    exit $rc
+  fi
+  n=$((n + 1))
+  if [ "$MAX" -gt 0 ] && [ "$n" -ge "$MAX" ]; then
+    echo "[$(date -u +%H:%M:%S)] giving up after $n polls"
+    exit 1
+  fi
+  echo "[$(date -u +%H:%M:%S)] tunnel down (poll $n); retry in ${INTERVAL}s"
+  sleep "$INTERVAL"
+done
