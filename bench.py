@@ -56,6 +56,11 @@ import time
 
 import numpy as np
 
+# local epochs per client-update — used by BOTH the timed legs (the
+# bench_jax/bench_torch epoch default) and the FLOPs accounting, so the
+# two cannot drift (r4 advisor)
+EPOCHS = 2
+
 
 def build_dataset(num_clients: int):
     from fedamw_tpu.data import FederatedDataset, dirichlet_partition
@@ -83,7 +88,7 @@ def _profile_ctx():
     return contextlib.nullcontext()
 
 
-def bench_jax(ds, D, rounds, algorithm="FedAvg", epoch=2, batch_size=32,
+def bench_jax(ds, D, rounds, algorithm="FedAvg", epoch=EPOCHS, batch_size=32,
               lr=0.5, **kw):
     from fedamw_tpu import algorithms
     from fedamw_tpu.algorithms import prepare_setup
@@ -153,7 +158,7 @@ def bench_jax_best(ds, D, rounds, algorithm="FedAvg", **kw):
     return best
 
 
-def bench_reference(ds, D, rounds, algorithm="FedAvg", epoch=2,
+def bench_reference(ds, D, rounds, algorithm="FedAvg", epoch=EPOCHS,
                     batch_size=32, lr=0.5, setup=None):
     """Time the ACTUAL reference loop (``functions/tools.py:329-463``),
     imported read-only, on the same RFF-mapped tensors as the torch
@@ -229,7 +234,7 @@ def make_torch_setup(ds, D):
                                    rng=np.random.RandomState(100))
 
 
-def bench_torch(ds, D, rounds, algorithm="FedAvg", epoch=2, batch_size=32,
+def bench_torch(ds, D, rounds, algorithm="FedAvg", epoch=EPOCHS, batch_size=32,
                 lr=0.5, setup=None, **kw):
     from fedamw_tpu.backends import torch_ref
 
@@ -257,7 +262,18 @@ def main():
 
         jax.config.update("jax_platforms", platforms)
     cpu_fallback = False
-    if platforms != "cpu" and not os.environ.get("BENCH_NO_PROBE"):
+    if os.environ.get("BENCH_FORCE_FALLBACK"):
+        # skip the 180 s probe when the tunnel is known-down (driver /
+        # watcher flows; also makes the fallback path testable): same
+        # labeled CPU capture as a failed probe
+        print("# BENCH_FORCE_FALLBACK: CPU fallback without probing — "
+              'metrics are CPU-vs-CPU and labeled platform="cpu"',
+              file=sys.stderr)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        cpu_fallback = True
+    elif platforms != "cpu" and not os.environ.get("BENCH_NO_PROBE"):
         # Fail fast instead of hanging forever when the remote-TPU
         # tunnel is wedged (observed: a crashed Mosaic compile leaves
         # the axon relay unreachable and the first backend query blocks
@@ -348,15 +364,22 @@ def main():
     # fallback is conservative (it is faster than the reference's loop).
     base_ups, base_arm = ((ref[0], "reference-loop") if ref is not None
                           else (torch_ups, "torch-backend"))
-    # first-principles FLOPs (PERFORMANCE.md § MFU/roofline): bias-free
-    # linear model, fwd GEMM 2·D·C per sample, bwd ≈ 2× fwd, 2 local
-    # epochs over the mean post-val-split (×0.8) client shard — makes
-    # the roofline numbers driver-captured, not hand-derived
-    # mean over ALL J clients (empty shards contribute 0 FLOPs but DO
-    # count as "updates" in updates/s, so excluding them would overstate
-    # achieved FLOP/s by the empty-client fraction)
+    # first-principles FLOPs (PERFORMANCE.md § MFU/roofline; shared
+    # definition in utils/flops.py so bench/scale_bench cannot drift):
+    # fwd counted from real initialized flagship-model params; n_mean
+    # over ALL J clients (empty shards contribute 0 FLOPs but DO count
+    # as "updates" in updates/s), ×0.8 for the pooled val split
+    import jax as _jax
+
+    from fedamw_tpu.models import linear_model
+    from fedamw_tpu.utils.flops import client_update_flops, \
+        fwd_flops_per_sample
+
+    _params = linear_model().init(_jax.random.PRNGKey(0), D,
+                                  ds.num_classes)
     n_mean = 0.8 * float(np.mean([len(p) for p in ds.parts]))
-    flops_upd = 3 * 2 * D * ds.num_classes * 2 * n_mean
+    flops_upd = client_update_flops(fwd_flops_per_sample(_params),
+                                    EPOCHS, n_mean)
     headline = {
         "metric": "client_updates_per_sec",
         "value": round(jax_ups, 2),

@@ -1,0 +1,40 @@
+"""First-principles FLOPs accounting shared by bench.py / scale_bench.py.
+
+One definition so the two harnesses cannot drift (r4 advisor): the
+client local-SGD cost of one *client-update* (= one client's full local
+training for one communication round) is
+
+    3 · fwd_flops_per_sample(params) · epochs · n_mean
+
+with fwd counted from the model's actual weight matrices (2·in·out per
+GEMM) and bwd ≈ 2× fwd (`x^T g` for the weight grad plus the input-side
+grad). This counts the client GEMMs ONLY — FedAMW's p-solver and logit
+cache are excluded (callers must label such records; see
+PERFORMANCE.md § MFU/roofline for the derivation and the measured
+utilization tables).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def fwd_flops_per_sample(params) -> int:
+    """Forward FLOPs for one sample: 2·(in·out) summed over the
+    model's 2-D weight leaves (bias adds are negligible and skipped)."""
+    import jax
+
+    return sum(
+        2 * int(np.prod(np.shape(w)))
+        for w in jax.tree.leaves(params)
+        if np.ndim(w) == 2
+    )
+
+
+def client_update_flops(fwd_per_sample: float, epochs: int,
+                        n_mean: float) -> float:
+    """FLOPs of one client-update (fwd+bwd ≈ 3× fwd, `epochs` passes
+    over a mean shard of `n_mean` samples). `n_mean` must average over
+    the SAME client population the updates/s rate counts (padded/empty
+    clients contribute 0 samples but still count as updates)."""
+    return 3.0 * fwd_per_sample * epochs * n_mean

@@ -52,6 +52,21 @@ def run_config(name, ds, model, kernel_type, D, num_clients, rounds,
         ds, D=D, kernel_par=0.1, kernel_type=kernel_type, seed=100,
         rng=np.random.RandomState(100), model=model, buckets=buckets,
     )
+    # first-principles FLOPs per client-update (PERFORMANCE.md § MFU;
+    # shared definition in utils/flops.py): fwd counted from the real
+    # initialized params (so MLP configs are exact); mean over ALL
+    # clients incl. zero-size padding (they count as "updates" in
+    # updates/s, so excluding them would overstate achieved FLOP/s)
+    import jax
+
+    from fedamw_tpu.utils.flops import client_update_flops, \
+        fwd_flops_per_sample
+
+    params = setup.model.init(jax.random.PRNGKey(0), setup.D,
+                              setup.num_classes)
+    n_mean = float(np.mean(np.asarray(setup.sizes)))
+    flops_upd = client_update_flops(fwd_flops_per_sample(params), epoch,
+                                    n_mean)
     recs = []
     for alg in algorithms:
         fn = getattr(algs, alg)
@@ -72,7 +87,16 @@ def run_config(name, ds, model, kernel_type, D, num_clients, rounds,
             "wall_s": round(dt, 3),
             "rounds": rounds,
             "buckets": buckets,
+            "flops_per_update": round(flops_upd),
+            "achieved_gflops": round(
+                setup.num_clients * rounds / dt * flops_upd / 1e9, 2),
         }
+        if alg != "FedAvg":
+            # the shared counter covers the client GEMMs only; FedAMW
+            # also runs the p-solver + logit cache, so its true FLOP/s
+            # is higher than this field — label rather than mislabel
+            rec["flops_note"] = ("client local-SGD GEMMs only; excludes "
+                                 "p-solver/logit work")
         if os.environ.get("SCALE_MEMORY", "1") != "0":
             # AOT compile report: the axon runtime has no live
             # memory_stats(), so the compiler's own buffer assignment is
