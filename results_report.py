@@ -1,0 +1,70 @@
+"""Render an ``exp1_{dataset}.pkl`` driver artifact into a results table.
+
+The reference emits its paper tables through LaTeX row builders
+(``functions/utils.py:355-378``); this renders the same content from
+the driver's pickle schema (``exp.py:109-121``, identical to reference
+``exp.py:132-143``): per-algorithm final test accuracy (mean ± std over
+repeats), the reference's own significance markup (best bold, rows not
+significantly worse underlined, threshold 1.812), and the per-repeat
+data-heterogeneity scores.
+
+Usage: python results_report.py results/exp1_digits.pkl [--markdown]
+"""
+
+import argparse
+
+import numpy as np
+
+from fedamw_tpu.utils.reporting import (check_significance, load_results,
+                                        print_acc)
+
+
+def final_acc(res):
+    # (6, R, n_repeats) -> final-round accuracies per algorithm
+    return np.asarray(res["test_acc"])[:, -1, :]
+
+
+def render_markdown(res):
+    acc = final_acc(res)
+    names = list(res["name"])
+    best = int(np.argmax(acc.mean(axis=1)))
+    lines = [
+        "| Algorithm | final test acc (mean±std over "
+        f"{acc.shape[1]} repeats) | vs best |",
+        "|---|---|---|",
+    ]
+    for i, name in enumerate(names):
+        row = acc[i]
+        if i == best:
+            mark = "**best**"
+        elif check_significance(row, acc[best]):
+            mark = "significantly worse"
+        else:
+            mark = "not significantly worse"
+        lines.append(f"| {name} | {row.mean():.2f}±{row.std():.2f} "
+                     f"| {mark} |")
+    het = np.asarray(res["heterogeneity"])
+    lines.append("")
+    lines.append(f"Data heterogeneity per repeat: "
+                 f"{np.round(het, 4).tolist()}; rounds={res['epochs']}.")
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("pkl")
+    ap.add_argument("--markdown", action="store_true",
+                    help="markdown table instead of the LaTeX row")
+    args = ap.parse_args()
+    res = load_results(args.pkl)
+    if args.markdown:
+        print(render_markdown(res))
+    else:
+        # the reference's exact emitter (best bold / underline rule)
+        print(" ".join(res["name"]))
+        print(print_acc(final_acc(res)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -49,6 +49,59 @@ def test_oracle_regression_learns(arms):
     assert ref["FedAMW"] < ref["FedAvg"]
 
 
+def test_default_lr_p_divergence_is_faithful():
+    """At the tuner CLI's default ``lr_p=0.1`` the regression p-solver
+    blows up (NaN by round 2) on the REFERENCE's own FedAMW — so the
+    repo reproducing that blow-up is parity, not a bug (PARITY.md §3
+    "known faithful divergence"; the NNI search space sweeps lr_p down
+    to 5e-6 precisely because of this)."""
+    import contextlib
+    import io
+
+    import torch
+
+    # the tuner's exact operating point: J=50 (tune.py hard-codes it);
+    # at smaller J the p-gradient happens to stay bounded
+    anchor = dict(oracle_parity.REG_ANCHOR, num_partitions=50, D=64,
+                  lr=0.001, lr_p=0.1, epoch=1)
+    setup = oracle_parity._build_torch_setup(1, anchor)
+
+    rt = oracle_parity._load_oracle()
+    torch.manual_seed(1)
+    X_train, y_train, validloader = oracle_parity.reference_inputs(setup)
+    with contextlib.redirect_stdout(io.StringIO()):
+        _, tl, _ = rt.FedAMW(
+            X_train, y_train, X_test=setup.X_test,
+            y_test=setup.y_test.reshape(-1, 1), type="regression",
+            num_classes=1, D=anchor["D"], lr=anchor["lr"],
+            epoch=anchor["epoch"], batch_size=anchor["batch_size"],
+            lambda_reg_if=True, lambda_reg=anchor["lambda_reg"],
+            round=2, lr_p=anchor["lr_p"], validloader=validloader)
+    assert not np.isfinite(float(np.asarray(tl)[-1]))
+
+    # both repo backends reproduce the blow-up (PARITY.md §3 claims
+    # "BOTH this repo's backends" — pin each so a later p-solver guard
+    # can't silently diverge from the reference here)
+    from fedamw_tpu.data import load_dataset
+    from fedamw_tpu.registry import get_backend
+
+    amw_kw = dict(lr=anchor["lr"], epoch=anchor["epoch"],
+                  batch_size=anchor["batch_size"], lambda_reg_if=True,
+                  lambda_reg=anchor["lambda_reg"], round=2,
+                  lr_p=anchor["lr_p"], seed=1, sequential=True)
+    for backend in ("torch", "jax"):
+        be = get_backend(backend)
+        rng = np.random.RandomState(1)
+        ds = load_dataset(anchor["dataset"], anchor["num_partitions"],
+                          anchor["alpha"], rng=rng)
+        bsetup = be.prepare_setup(ds, D=anchor["D"],
+                                  kernel_par=anchor["kernel_par"],
+                                  seed=1, rng=rng)
+        res = be.ALGORITHMS["FedAMW"](bsetup, **amw_kw)
+        assert not np.isfinite(float(np.asarray(res["test_loss"])[-1])), \
+            backend
+
+
 def test_repo_torch_matches_oracle_mse(arms):
     """Same tensors, same sequential semantics, independent
     implementations; single seed, so the band covers shuffle/init RNG
