@@ -158,6 +158,36 @@ def bench_jax_best(ds, D, rounds, algorithm="FedAvg", **kw):
     return best
 
 
+def bucket_sweep(ds, D, rounds):
+    """Env-gated (BENCH_SWEEP_BUCKETS="8,16,32,64") sweep of the
+    size-bucket count. The workload is op-overhead-bound (PERFORMANCE.md
+    § MFU: padding FLOPs are ~free at <0.1% MXU), so fewer buckets =
+    fewer sub-programs per round = less dispatch/fusion overhead, at
+    the cost of padding — where the optimum sits is a hardware
+    question, which is why this ships as a window-harvest step rather
+    than a fixed default. Returns {bucket_count: updates/s} or None."""
+    counts = os.environ.get("BENCH_SWEEP_BUCKETS")
+    if not counts:
+        return None
+    saved = os.environ.get("BENCH_BUCKETS")
+    out = {}
+    try:
+        for b in counts.split(","):
+            b = b.strip()
+            os.environ["BENCH_BUCKETS"] = b
+            ups, acc, dt = bench_jax(ds, D, rounds)
+            out[b] = round(ups, 1)
+            print(f"# bucket sweep: {b:>3} buckets -> {ups:9.1f} "
+                  f"updates/s ({rounds} rounds in {dt:.2f}s, acc "
+                  f"{acc:.2f})", file=sys.stderr)
+    finally:
+        if saved is None:
+            os.environ.pop("BENCH_BUCKETS", None)
+        else:
+            os.environ["BENCH_BUCKETS"] = saved
+    return out
+
+
 def bench_reference(ds, D, rounds, algorithm="FedAvg", epoch=EPOCHS,
                     batch_size=32, lr=0.5, setup=None):
     """Time the ACTUAL reference loop (``functions/tools.py:329-463``),
@@ -324,6 +354,13 @@ def main():
 
     platform = jax.default_backend()
 
+    if os.environ.get("BENCH_SWEEP_ONLY"):
+        # sweep-only run (tpu_window.sh step 4/4): skip the headline /
+        # torch / reference / FedAMW legs — the window's earlier steps
+        # already harvested them — and emit just the sweep line
+        _emit_bucket_sweep(ds, D, rounds, platform)
+        return
+
     jax_ups, jax_acc, jax_dt, jax_impl = bench_jax_best(ds, D, rounds)
     tsetup = make_torch_setup(ds, D)
     torch_ups, torch_acc, torch_dt = bench_torch(ds, D, torch_rounds,
@@ -439,6 +476,10 @@ def main():
                   f"took {jax_dt:.1f}s — cold cache; headline first); "
                   "set BENCH_FALLBACK_AMW=1 or BENCH_CPU_FALLBACK_FULL=1 "
                   "to keep it", file=sys.stderr)
+        if os.environ.get("BENCH_SWEEP_BUCKETS"):
+            print("# bucket sweep skipped in CPU fallback (headline "
+                  "first); use BENCH_SWEEP_ONLY=1 for a sweep-only run",
+                  file=sys.stderr)
         print(json.dumps(headline))
         return
     try:
@@ -479,8 +520,30 @@ def main():
     except Exception as e:  # pragma: no cover - defensive
         print(f"# FedAMW leg failed: {e!r}", file=sys.stderr)
 
+    _emit_bucket_sweep(ds, D, rounds, platform)
+
     # headline metric last (FedAvg throughput, the BASELINE.json anchor)
     print(json.dumps(headline))
+
+
+def _emit_bucket_sweep(ds, D, rounds, platform):
+    """Run the env-gated sweep and print its JSON line; never raise —
+    a sweep-leg failure (compile/OOM at an untried bucket count) must
+    not cost the headline line that prints after it."""
+    try:
+        sweep = bucket_sweep(ds, D, rounds)
+    except Exception as e:  # pragma: no cover - platform-dependent
+        print(f"# bucket sweep failed: {e!r}", file=sys.stderr)
+        return
+    if sweep:
+        print(json.dumps({
+            "metric": "bucket_sweep_updates_per_sec",
+            "value": max(sweep.values()),
+            "unit": "client-updates/s",
+            "buckets": sweep,
+            "default_buckets": os.environ.get("BENCH_BUCKETS", "32"),
+            "platform": platform,
+        }))
 
 
 if __name__ == "__main__":

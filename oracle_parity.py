@@ -21,7 +21,7 @@ the non-degenerate anchor: FedAvg/FedProx genuinely learn here
 pins accuracy at the constant-argmax frequency (VERDICT.md, weak #2).
 
 Usage:
-  JAX_PLATFORMS=cpu python oracle_parity.py [--seeds 5] [--round 30]
+  JAX_PLATFORMS=cpu python oracle_parity.py [--seeds 10] [--round 30]
       [--out results_parity/oracle_summary.json]
   python oracle_parity.py --render results_parity/oracle_summary.json
 """
@@ -73,8 +73,8 @@ REG_ANCHOR = dict(
 # alpha=0.01 default pins fixed-p averaging at the constant-argmax
 # frequency; PARITY.md §2 attributes that degeneracy with the oracle).
 # lr=2.0 as in the §1 anchor; the sequential oracle is slow at J=50
-# (~70 s/seed), so the committed matrix trades rounds for seeds:
-# 5 seeds at R=10 — a real paired t-test at a reduced round budget
+# (~60 s/seed), so the committed matrix trades rounds for seeds:
+# 10 seeds at R=10 — a real paired t-test at a reduced round budget
 # (stated in PARITY.md §4).
 EXP50_ANCHOR = dict(
     task="classification",
@@ -462,7 +462,8 @@ def degenerate_check(rounds=30, seed=100):
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--seeds", type=int, default=5)
+    ap.add_argument("--seeds", type=int, default=10,
+                    help="all committed PARITY.md matrices use 10")
     ap.add_argument("--seed0", type=int, default=100)
     ap.add_argument("--round", type=int, default=30)
     ap.add_argument("--task",

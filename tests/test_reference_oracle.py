@@ -1,6 +1,6 @@
 """The ACTUAL reference code as a read-only parity oracle (fast tier).
 
-``oracle_parity.py`` is the full 5-seed harness behind PARITY.md §1;
+``oracle_parity.py`` is the full 10-seed harness behind PARITY.md §1;
 this test pins the capability in CI at a small operating point: import
 ``/root/reference/functions/tools.py`` (never copied), feed it the SAME
 RFF-mapped tensors as the repo's torch backend, and require agreement.
@@ -62,7 +62,7 @@ def test_oracle_runs_all_seven_and_learns(arms):
 def test_repo_torch_matches_oracle(arms):
     """Same tensors, same sequential semantics, independent
     implementations; single seed, so the band covers shuffle/init RNG
-    noise (the 5-seed statistical test lives in PARITY.md §1)."""
+    noise (the 10-seed statistical test lives in PARITY.md §1)."""
     ref, repo = arms
     for algo in oracle_parity.ALGOS:
         # FedAMW_OneShot: the reference has the aliasing bug (client 0's
@@ -70,7 +70,7 @@ def test_repo_torch_matches_oracle(arms):
         # tools.py:318-320 — compounding to p[0]^t), which the repo
         # deliberately does NOT reproduce. At J=8 effectively deleting
         # client 0 from the ensemble is material, so the bug itself
-        # creates a real gap; at the PARITY.md anchor (J=20, 5 seeds)
+        # creates a real gap; at the PARITY.md anchor (J=20, 10 seeds)
         # the arms still agree statistically.
         band = 25.0 if algo == "FedAMW_OneShot" else 12.0
         assert abs(ref[algo] - repo[algo]) <= band, (

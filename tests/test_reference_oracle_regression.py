@@ -5,7 +5,7 @@ the reference's synthetic regression path (``tune.py:58-66`` →
 ``load_synthetic_data``, ``utils.py:74-84``) routes ``train_loop``/
 ``test_loop`` through ``nn.MSELoss`` (``tools.py:183-184, 231-234``).
 This pins that branch against the repo's torch backend at a test-sized
-operating point; the 5-seed statistical matrix lives in PARITY.md §3
+operating point; the 10-seed statistical matrix lives in PARITY.md §3
 (``oracle_parity.py --task regression``). Skips when the reference
 checkout is absent (other machines).
 """

@@ -36,8 +36,19 @@ FEDAMW_TEST_PLATFORM=tpu timeout 1200 python -m pytest tests/test_pallas_tpu.py 
 echo "rc=$? pallas"; tail -3 "$OUT/pallas.log"
 
 echo "[$(stamp)] probe"; probe
-echo "[$(stamp)] 3/3 scale_bench.py"
+echo "[$(stamp)] 3/4 scale_bench.py"
 timeout 1800 python scale_bench.py >"$OUT/scale.json" 2>"$OUT/scale.log"
 echo "rc=$? scale"; tail -2 "$OUT/scale.json" 2>/dev/null
+
+echo "[$(stamp)] probe"; probe
+echo "[$(stamp)] 4/4 bucket sweep (op-overhead-bound workload: where is"
+echo "          the padding-vs-dispatch optimum on real hardware?)"
+# BENCH_SWEEP_ONLY skips the headline/torch/reference/FedAMW legs the
+# earlier steps already harvested — the 1200 s cap covers only the 4
+# sweep compiles+runs
+BENCH_STRICT_TPU=1 BENCH_SWEEP_ONLY=1 BENCH_SWEEP_BUCKETS="8,16,32,64" \
+  timeout 1200 python bench.py \
+  >"$OUT/bucket_sweep.json" 2>"$OUT/bucket_sweep.log"
+echo "rc=$? sweep"; grep bucket_sweep "$OUT/bucket_sweep.json" 2>/dev/null
 
 echo "[$(stamp)] done -> $OUT/"
