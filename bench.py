@@ -219,7 +219,8 @@ def _bench_reference(ds, D, rounds, algorithm, epoch, batch_size, lr,
 
     import torch
 
-    from oracle_parity import _load_oracle, reference_inputs
+    from oracle_parity import (_load_oracle, reference_inputs,
+                               reference_y_test)
 
     # scoped sys.path insert (no exp/tune shadowing), device pinned to
     # CPU (the baseline must be CPU wall-clock)
@@ -234,13 +235,8 @@ def _bench_reference(ds, D, rounds, algorithm, epoch, batch_size, lr,
     with torch.random.fork_rng():
         torch.manual_seed(100)
         X_train, y_train, validloader = reference_inputs(setup)
-        y_test = setup.y_test
-        if setup.task != "classification":
-            # match reference_inputs' (n, 1) regression labels — a flat
-            # y_test against the reference model's (n, 1) output would
-            # make nn.MSELoss broadcast to (n, n)
-            y_test = y_test.reshape(-1, 1)
-        kw = dict(X_test=setup.X_test, y_test=y_test,
+        kw = dict(X_test=setup.X_test,
+                  y_test=reference_y_test(setup),
                   type=setup.task, num_classes=setup.num_classes,
                   D=setup.D, lr=lr, epoch=epoch, batch_size=batch_size)
         if algorithm == "FedAMW":
@@ -406,13 +402,11 @@ def main():
     # fwd counted from real initialized flagship-model params; n_mean
     # over ALL J clients (empty shards contribute 0 FLOPs but DO count
     # as "updates" in updates/s), ×0.8 for the pooled val split
-    import jax as _jax
-
     from fedamw_tpu.models import linear_model
     from fedamw_tpu.utils.flops import client_update_flops, \
         fwd_flops_per_sample
 
-    _params = linear_model().init(_jax.random.PRNGKey(0), D,
+    _params = linear_model().init(jax.random.PRNGKey(0), D,
                                   ds.num_classes)
     n_mean = 0.8 * float(np.mean([len(p) for p in ds.parts]))
     flops_upd = client_update_flops(fwd_flops_per_sample(_params),

@@ -134,6 +134,16 @@ def reference_inputs(setup, val_batch_size=16):
     return X_train, y_train, validloader
 
 
+def reference_y_test(setup):
+    """``setup.y_test`` in the reference's calling convention: ``(n, 1)``
+    for regression (the shape its ``nn.MSELoss`` expects against the
+    model's ``(n, 1)`` output — flat labels would broadcast to
+    ``(n, n)``), unchanged for classification."""
+    if setup.task != "classification":
+        return setup.y_test.reshape(-1, 1)
+    return setup.y_test
+
+
 def _final(res, key="test_acc"):
     return float(np.asarray(res[key]).reshape(-1)[-1])
 
@@ -160,11 +170,8 @@ def run_oracle(setup, rounds, seed, anchor=None):
     rt = _load_oracle()
     torch.manual_seed(seed)
     X_train, y_train, validloader = reference_inputs(setup)
-    y_test = setup.y_test
-    if setup.task != "classification":
-        y_test = y_test.reshape(-1, 1)
-    kw = dict(X_test=setup.X_test, y_test=y_test, type=setup.task,
-              num_classes=setup.num_classes, D=setup.D,
+    kw = dict(X_test=setup.X_test, y_test=reference_y_test(setup),
+              type=setup.task, num_classes=setup.num_classes, D=setup.D,
               batch_size=anchor["batch_size"])
     lr, ep, task = anchor["lr"], anchor["epoch"], setup.task
     out = {}

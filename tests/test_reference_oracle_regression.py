@@ -72,7 +72,8 @@ def test_default_lr_p_divergence_is_faithful():
     with contextlib.redirect_stdout(io.StringIO()):
         _, tl, _ = rt.FedAMW(
             X_train, y_train, X_test=setup.X_test,
-            y_test=setup.y_test.reshape(-1, 1), type="regression",
+            y_test=oracle_parity.reference_y_test(setup),
+            type="regression",
             num_classes=1, D=anchor["D"], lr=anchor["lr"],
             epoch=anchor["epoch"], batch_size=anchor["batch_size"],
             lambda_reg_if=True, lambda_reg=anchor["lambda_reg"],
