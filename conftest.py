@@ -27,13 +27,10 @@ if os.environ.get("FEDAMW_TEST_PLATFORM", "cpu") == "cpu":
     # disk instead of recompiling. Exported via env (not just
     # config.update) so subprocess-based tests — bench contract, the
     # dryrun respawn, multihost children, the NNI trial — inherit it.
-    os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     ".jax_cache"),
-    )
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
-                          "0.5")
+    # One shared definition with the driver dryrun's respawn env.
+    from __graft_entry__ import export_jit_cache_env
+
+    export_jit_cache_env(os.environ)
     jax.config.update(
         "jax_compilation_cache_dir",
         os.environ["JAX_COMPILATION_CACHE_DIR"],
