@@ -58,7 +58,12 @@ def epoch_gather_bytes(
     return J * num_batches * batch_size * D * itemsize
 
 
-_KERNEL_IMPLS = ("auto", "xla", "pallas", "pallas_interpret")
+# "pallas_col" is the transpose-free column-major epoch kernel — the
+# prepared fallback for the row kernel's in-kernel w.T/dz.T relayouts
+# (the one audited residual Mosaic-lowering risk); "pallas_col_interpret"
+# is its interpreter-mode twin for tests
+_KERNEL_IMPLS = ("auto", "xla", "pallas", "pallas_interpret",
+                 "pallas_col", "pallas_col_interpret")
 
 # Backends whose devices are TPUs (pallas/mosaic can lower). "axon" is
 # the remote-attach TPU plugin used on single-chip dev boxes.
@@ -86,7 +91,8 @@ def resolve_kernel_impl(kernel_impl: str, params,
     (even when forced) for incompatible params or step-gather mode,
     where it would crash or materialize the buffer the step path exists
     to avoid. Everything else uses the XLA scan kernel.
-    FEDAMW_KERNEL=xla|pallas overrides an 'auto' argument only; an
+    FEDAMW_KERNEL=xla|pallas|pallas_col (or the *_interpret twins)
+    overrides an 'auto' argument only; an
     explicit argument wins.
     """
     import os
@@ -101,7 +107,7 @@ def resolve_kernel_impl(kernel_impl: str, params,
                 )
             kernel_impl = forced
     if kernel_impl.startswith("pallas"):
-        interpret = kernel_impl == "pallas_interpret"
+        interpret = kernel_impl.endswith("_interpret")
         if _pallas_compatible(params) and use_epoch_gather and (
             interpret or jax.default_backend() in _TPU_BACKENDS
         ):
@@ -194,7 +200,9 @@ def make_local_update(
                 C, D = p[wkey].shape
                 epoch_fn = make_pallas_epoch(
                     task, C, D, batch_size, num_batches,
-                    interpret=(impl == "pallas_interpret"),
+                    interpret=impl.endswith("_interpret"),
+                    layout=("col" if impl.startswith("pallas_col")
+                            else "row"),
                 )
                 scal = jnp.stack([lr, mu, lam]).astype(jnp.float32)
                 w, met = epoch_fn(p[wkey], anchor[wkey], X[rows], y[rows],

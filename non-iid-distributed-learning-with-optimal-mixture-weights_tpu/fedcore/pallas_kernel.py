@@ -42,9 +42,18 @@ def _epoch_kernel(
     C: int,
     D: int,
     B: int,
-    w0_ref,       # (C, D) epoch-start params
-    a_ref,        # (C, D) prox anchor: the client's ROUND-incoming params
-                  # (tools.py:180) — differs from w0 after the 1st epoch
+    col: bool,    # weight layout: False -> (C, D) with in-kernel
+                  # transposes at the two dot sites; True -> (D, C)
+                  # transpose-free (forward is a direct (B,D)x(D,C) MXU
+                  # op, weight grad contracts the batch dim via
+                  # dot_general — the same pattern pallas_psolver.py
+                  # uses). "col" is the prepared fallback for the row
+                  # layout's audited Mosaic-lowering risk (the w.T/dz.T
+                  # relayouts); callers transpose at the XLA boundary,
+                  # where a transpose is a free layout assignment.
+    w0_ref,       # (C, D) / (D, C) epoch-start params (per `col`)
+    a_ref,        # same shape: prox anchor, the client's ROUND-incoming
+                  # params (tools.py:180) — differs from w0 after epoch 1
     x_ref,        # (1, B, D) this step's batch features
     y_ref,        # (1, B, 1) labels (int32 classification / f32
                   #   regression), column layout — the trailing singleton
@@ -57,7 +66,7 @@ def _epoch_kernel(
                   #   "Offset change"; same layout as pallas_psolver.py)
     bv_ref,       # (1, B, 1) batch-validity mask (same layout)
     scal_ref,     # (3,) SMEM: lr, mu, lam
-    w_out_ref,    # (C, D) final weights
+    w_out_ref,    # final weights (same shape as w0)
     met_ref,      # (1, 3) loss*cnt sum, correct sum, cnt sum
     w_ref,        # VMEM scratch: live weights
     acc_ref,      # SMEM scratch: metric accumulators
@@ -80,7 +89,8 @@ def _epoch_kernel(
 
     cnt = jnp.sum(bvc)
     inv_cnt = 1.0 / jnp.maximum(cnt, 1.0)
-    z = jnp.dot(xb, w.T, preferred_element_type=jnp.float32)  # (B, C)
+    z = jnp.dot(xb, w if col else w.T,
+                preferred_element_type=jnp.float32)  # (B, C)
 
     # every reduced tensor stays 2-D ((B, 1) columns / (B, C) blocks):
     # Mosaic cannot lower 1-D (B,)-shaped compare/sum chains ("Offset
@@ -113,7 +123,15 @@ def _epoch_kernel(
         correct = 0.0
 
     data_loss = jnp.sum(per * bvc) * inv_cnt
-    grad = jnp.dot(dz.T, xb, preferred_element_type=jnp.float32)  # (C, D)
+    if col:
+        # grad wrt (D, C) weights: contract the batch dim of xb and dz
+        # — no operand transposed inside the kernel
+        grad = jax.lax.dot_general(
+            xb, dz, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # (D, C)
+    else:
+        grad = jnp.dot(dz.T, xb,
+                       preferred_element_type=jnp.float32)  # (C, D)
 
     # unsquared norms, grad 0 at 0 (ops/losses.py:l2_norm_safe)
     diff = w - anchor
@@ -145,24 +163,33 @@ def _epoch_kernel(
 
 @functools.lru_cache(maxsize=64)
 def make_pallas_epoch(task: str, C: int, D: int, B: int, S: int,
-                      interpret: bool = False):
+                      interpret: bool = False, layout: str = "row"):
     """Build ``epoch(w0, anchor, Xe (S,B,D), ye (S,B), bv (S,B), scal (3,)) ->
     (w (C,D), metrics (3,))`` — one client's full epoch as one fused
     Pallas program. ``scal`` packs (lr, mu, lam). vmap over the client
-    axis adds the leading grid dimension."""
+    axis adds the leading grid dimension.
+
+    ``layout="col"`` selects the transpose-free column-major form
+    (weights (D, C) inside the program; see the ``col`` flag on
+    ``_epoch_kernel``) — same ``(C, D)``-in/out call signature,
+    transposed at the XLA boundary."""
+    col = layout == "col"
     kernel = functools.partial(
-        _epoch_kernel, task == "classification", C, D, B
+        _epoch_kernel, task == "classification", C, D, B, col
     )
+    w_shape = (D, C) if col else (C, D)
     y_dtype = jnp.int32 if task == "classification" else jnp.float32
 
     def epoch(w0, anchor, Xe, ye, bv, scal):
+        if col:
+            w0, anchor = w0.T, anchor.T
         w, met = pl.pallas_call(
             kernel,
             grid=(S,),
             in_specs=[
-                pl.BlockSpec((C, D), lambda s: (0, 0),
+                pl.BlockSpec(w_shape, lambda s: (0, 0),
                              memory_space=pltpu.VMEM),
-                pl.BlockSpec((C, D), lambda s: (0, 0),
+                pl.BlockSpec(w_shape, lambda s: (0, 0),
                              memory_space=pltpu.VMEM),
                 pl.BlockSpec((1, B, D), lambda s: (s, 0, 0),
                              memory_space=pltpu.VMEM),
@@ -173,22 +200,22 @@ def make_pallas_epoch(task: str, C: int, D: int, B: int, S: int,
                 pl.BlockSpec(memory_space=pltpu.SMEM),
             ],
             out_specs=[
-                pl.BlockSpec((C, D), lambda s: (0, 0),
+                pl.BlockSpec(w_shape, lambda s: (0, 0),
                              memory_space=pltpu.VMEM),
                 pl.BlockSpec((1, 3), lambda s: (0, 0),
                              memory_space=pltpu.SMEM),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((C, D), jnp.float32),
+                jax.ShapeDtypeStruct(w_shape, jnp.float32),
                 jax.ShapeDtypeStruct((1, 3), jnp.float32),
             ],
             scratch_shapes=[
-                pltpu.VMEM((C, D), jnp.float32),
+                pltpu.VMEM(w_shape, jnp.float32),
                 pltpu.SMEM((3,), jnp.float32),
             ],
             interpret=interpret,
         )(w0, anchor, Xe, ye.astype(y_dtype)[..., None],
           bv[..., None], scal)
-        return w, met[0]
+        return (w.T if col else w), met[0]
 
     return epoch

@@ -42,7 +42,7 @@ def test_bench_emits_driver_contract_json():
         assert rec["vs_baseline"] > 0
         assert rec["platform"] == "cpu"
         assert rec["baseline_arm"] in ("reference-loop", "torch-backend")
-        assert rec["impl"] in ("xla", "pallas")
+        assert rec["impl"] in ("xla", "pallas", "pallas_col")
     # driver-captured roofline fields (PERFORMANCE.md § MFU)
     assert lines[-1]["flops_per_update"] > 0
     assert lines[-1]["achieved_gflops"] > 0

@@ -40,10 +40,12 @@ def _client(n, seed=1):
     return idx, mask, n_max
 
 
+@pytest.mark.parametrize("impl", ["pallas_interpret",
+                                  "pallas_col_interpret"])
 @pytest.mark.parametrize("task", ["classification", "regression"])
 @pytest.mark.parametrize("mu,lam", [(0.0, 0.0), (0.05, 0.0),
                                     (0.0, 0.01), (0.05, 0.01)])
-def test_pallas_matches_xla_single_client(task, mu, lam):
+def test_pallas_matches_xla_single_client(task, mu, lam, impl):
     X, y, w0 = _data(task)
     idx, mask, n_max = _client(50)
     key = jax.random.PRNGKey(7)
@@ -56,7 +58,7 @@ def test_pallas_matches_xla_single_client(task, mu, lam):
     lu_x = make_local_update(linear_model().apply, task, 2, B, n_max,
                              kernel_impl="xla")
     lu_p = make_local_update(None, task, 2, B, n_max,
-                             kernel_impl="pallas_interpret")
+                             kernel_impl=impl)
     wx, lx, ax = lu_x(w0, *args)
     wp, lp, ap = lu_p(w0, *args)
     np.testing.assert_allclose(np.asarray(wp["w"]), np.asarray(wx["w"]),
@@ -76,7 +78,9 @@ def test_pallas_empty_client_is_inert():
     assert float(lp) == 0.0
 
 
-def test_pallas_matches_xla_vmapped_round():
+@pytest.mark.parametrize("impl", ["pallas_interpret",
+                                  "pallas_col_interpret"])
+def test_pallas_matches_xla_vmapped_round(impl):
     from fedamw_tpu.models import linear_model
 
     task = "classification"
@@ -92,7 +96,7 @@ def test_pallas_matches_xla_vmapped_round():
     rf_x = jax.jit(make_client_round(linear_model().apply, task, 2, B,
                                      n_max, kernel_impl="xla"))
     rf_p = jax.jit(make_client_round(linear_model().apply, task, 2, B,
-                                     n_max, kernel_impl="pallas_interpret"))
+                                     n_max, kernel_impl=impl))
     sx, lx, ax = rf_x(w0, *args)
     sp, lp, ap = rf_p(w0, *args)
     np.testing.assert_allclose(np.asarray(sp["w"]), np.asarray(sx["w"]),

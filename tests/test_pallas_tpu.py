@@ -27,14 +27,20 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-def test_epoch_kernel_lowers_and_matches_interpret():
+@pytest.mark.parametrize("layout", ["row", "col"])
+def test_epoch_kernel_lowers_and_matches_interpret(layout):
+    """Both layouts: "row" is the default; "col" is the transpose-free
+    fallback for the row kernel's in-kernel w.T/dz.T relayouts (the one
+    audited residual Mosaic risk) — if row fails to lower here, col is
+    the drop-in (FEDAMW_KERNEL=pallas_col)."""
     import jax.numpy as jnp
 
     from fedamw_tpu.fedcore.pallas_kernel import make_pallas_epoch
 
     C, D, B, S = 2, 2000, 32, 7
     rng = np.random.RandomState(0)
-    epoch = make_pallas_epoch("classification", C, D, B, S)
+    epoch = make_pallas_epoch("classification", C, D, B, S,
+                              layout=layout)
     w0 = jnp.asarray(rng.randn(C, D).astype(np.float32) * 0.01)
     Xe = jnp.asarray(rng.randn(S, B, D).astype(np.float32))
     ye = jnp.asarray(rng.randint(0, C, (S, B)).astype(np.int32))
@@ -44,7 +50,8 @@ def test_epoch_kernel_lowers_and_matches_interpret():
     w, met = jax.jit(epoch)(w0, w0, Xe, ye, bv, scal)
     w, met = np.asarray(w), np.asarray(met)
 
-    ref = make_pallas_epoch("classification", C, D, B, S, interpret=True)
+    ref = make_pallas_epoch("classification", C, D, B, S, interpret=True,
+                            layout=layout)
     w_i, met_i = jax.jit(ref)(w0, w0, Xe, ye, bv, scal)
     np.testing.assert_allclose(w, np.asarray(w_i), rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(met, np.asarray(met_i), rtol=1e-4)
