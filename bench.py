@@ -138,24 +138,35 @@ def bench_jax_best(ds, D, rounds, algorithm="FedAvg", **kw):
     saved = {k: os.environ.get(k) for k in ("FEDAMW_KERNEL",
                                             "FEDAMW_PSOLVER")}
     try:
-        # two epoch-kernel layouts: "pallas" (row) is the default;
-        # "pallas_col" is the transpose-free fallback for the row
-        # kernel's audited Mosaic-lowering risk — trying both keeps an
-        # unattended window harvest productive even if one fails to
-        # lower, and the faster valid one wins
-        for impl in ("pallas", "pallas_col"):
+        # layout pairs: the default row/reshape kernels first, then the
+        # transpose-free hedges (pallas_col epoch kernel + pallas_nt
+        # p-solver) built for the kernels' audited Mosaic-lowering
+        # risks. If a diagonal pair FAILS (lowering error, not an
+        # accuracy discard), the mixed pairs are also tried — a valid
+        # (pallas, pallas_nt) combo must not be lost just because its
+        # pair-mates each broke one leg. Fastest valid pair wins.
+        pairs = [("pallas", "pallas"), ("pallas_col", "pallas_nt"),
+                 ("pallas", "pallas_nt"), ("pallas_col", "pallas")]
+        failed = False
+        for i, (kern, psolv) in enumerate(pairs):
+            if i >= 2 and (not failed or algorithm != "FedAMW"):
+                # both diagonals lowered, or the algorithm never runs
+                # the p-solver (mixed pairs would just re-time kernels)
+                break
             try:
-                os.environ["FEDAMW_KERNEL"] = impl
-                os.environ["FEDAMW_PSOLVER"] = "pallas"
+                os.environ["FEDAMW_KERNEL"] = kern
+                os.environ["FEDAMW_PSOLVER"] = psolv
                 cand = bench_jax(ds, D, rounds, algorithm=algorithm, **kw)
                 if abs(cand[1] - xla[1]) > 0.5:
-                    print(f"# {algorithm} {impl} leg acc {cand[1]:.2f} "
-                          f"!= xla {xla[1]:.2f}; discarding",
-                          file=sys.stderr)
+                    print(f"# {algorithm} {kern}+{psolv} leg acc "
+                          f"{cand[1]:.2f} != xla {xla[1]:.2f}; "
+                          "discarding", file=sys.stderr)
                 elif cand[0] > best[0]:
-                    best = (*cand, impl)
+                    best = (*cand, f"{kern}+{psolv}"
+                            if algorithm == "FedAMW" else kern)
             except Exception as e:  # pragma: no cover - platform-dep.
-                print(f"# {algorithm} {impl} leg unavailable: "
+                failed = True
+                print(f"# {algorithm} {kern}+{psolv} leg unavailable: "
                       f"{type(e).__name__}", file=sys.stderr)
     finally:
         for k, v in saved.items():

@@ -90,24 +90,33 @@ def client_logits(apply_fn: Callable, stacked_params, X: jax.Array) -> jax.Array
 
 
 def resolve_psolver_impl(kernel_impl: str = "auto") -> str:
-    """Pick the p-solver implementation: 'xla' or 'pallas'[_interpret].
+    """Pick the p-solver implementation: 'xla' or 'pallas'[_interpret],
+    plus 'pallas_nt'[_interpret] — the reshape-free forward kept as the
+    hedge for the kernel's one audited Mosaic-lowering risk (the
+    (1, J) -> (J, 1) relayout; see ``_p_epoch_kernel``).
 
-    Mirrors ``client.resolve_kernel_impl``: FEDAMW_PSOLVER=xla|pallas
-    overrides an 'auto' argument; 'auto' currently resolves to XLA
-    everywhere — the Pallas path is numerically pinned against it in
-    interpreter mode (tests/test_pallas_psolver.py) but hardware
-    validation on the axon remote-attach lowering is pending, and the
-    interpret-mode kernel is a test vehicle (far slower than XLA on
-    CPU). Opt in with FEDAMW_PSOLVER=pallas.
+    Mirrors ``client.resolve_kernel_impl``: FEDAMW_PSOLVER overrides an
+    'auto' argument; 'auto' currently resolves to XLA everywhere — the
+    Pallas paths are numerically pinned against it in interpreter mode
+    (tests/test_pallas_psolver.py) but hardware validation on the axon
+    remote-attach lowering is pending, and the interpret-mode kernels
+    are test vehicles (far slower than XLA on CPU). Opt in with
+    FEDAMW_PSOLVER=pallas (or pallas_nt).
     """
     import os
 
+    allowed = ("xla", "pallas", "pallas_interpret",
+               "pallas_nt", "pallas_nt_interpret")
     if kernel_impl == "auto":
         forced = os.environ.get("FEDAMW_PSOLVER", "").strip().lower()
-        if forced in ("xla", "pallas", "pallas_interpret"):
-            kernel_impl = forced
-        else:
-            kernel_impl = "xla"
+        if not forced:
+            return "xla"
+        if forced not in allowed:
+            # a typo must not silently run XLA during an unattended
+            # hardware-validation window (mirrors FEDAMW_KERNEL's check)
+            raise ValueError(
+                f"FEDAMW_PSOLVER={forced!r}; expected one of {allowed}")
+        kernel_impl = forced
     return kernel_impl
 
 
@@ -223,14 +232,15 @@ def make_p_solver(
     if kernel_impl.startswith("pallas"):
         return _make_pallas_solve(
             task, n_val, batch_size, lr_p, momentum,
-            interpret=kernel_impl == "pallas_interpret",
+            interpret=kernel_impl.endswith("_interpret"),
+            nt=kernel_impl.startswith("pallas_nt"),
             fallback=solve,
         ), init_opt_state
     return solve, init_opt_state
 
 
 def _make_pallas_solve(task, n_val, batch_size, lr_p, momentum, interpret,
-                       fallback):
+                       nt, fallback):
     """Fused-kernel drop-in for the XLA ``solve`` (same signature and
     RNG stream; semantics pinned in ``tests/test_pallas_psolver.py``).
 
@@ -257,7 +267,7 @@ def _make_pallas_solve(task, n_val, batch_size, lr_p, momentum, interpret,
             return fallback(logits, y_val, p, opt_state, key, num_epochs,
                             client_valid)
         p_epoch = make_pallas_p_epoch(task, C, J, batch_size, n_batches,
-                                      interpret)
+                                      interpret, nt)
         scal = jnp.asarray([lr_p, momentum], jnp.float32)
         cv = (jnp.ones((1, J), jnp.float32) if client_valid is None
               else client_valid.reshape(1, J).astype(jnp.float32))

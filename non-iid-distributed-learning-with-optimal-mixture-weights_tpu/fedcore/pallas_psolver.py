@@ -40,6 +40,13 @@ def _p_epoch_kernel(
     C: int,
     J: int,
     B: int,
+    nt: bool,    # no-transpose forward: contract the J (lane) dims of
+                 # lb[c] (B, J) and p (1, J) via dot_general instead of
+                 # relaying p to a (J, 1) column first. The reshape is
+                 # this kernel's one audited residual Mosaic-lowering
+                 # risk ((1, J) lanes -> (J, 1) sublanes); select the
+                 # hedge with FEDAMW_PSOLVER=pallas_nt if it fails on
+                 # hardware.
     p0_ref,      # (1, J) epoch-start mixture weights
     buf0_ref,    # (1, J) epoch-start momentum buffer
     cv_ref,      # (1, J) client-validity mask (1s when unused)
@@ -72,13 +79,19 @@ def _p_epoch_kernel(
 
     cnt = jnp.sum(bvc)
     inv_cnt = 1.0 / jnp.maximum(cnt, 1.0)
-    p_col = p.reshape(J, 1)
 
     # z[:, c] = lb[c] @ p — C static tiny, unrolled; each term is a
-    # (B, J) x (J, 1) matvec on the MXU
-    z = jnp.concatenate(
-        [jnp.dot(lb[c], p_col, preferred_element_type=jnp.float32)
-         for c in range(C)], axis=1)    # (B, C)
+    # (B, J) x J-vector matvec on the MXU
+    if nt:
+        z = jnp.concatenate(
+            [jax.lax.dot_general(lb[c], p, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+             for c in range(C)], axis=1)  # (B, 1) each -> (B, C)
+    else:
+        p_col = p.reshape(J, 1)
+        z = jnp.concatenate(
+            [jnp.dot(lb[c], p_col, preferred_element_type=jnp.float32)
+             for c in range(C)], axis=1)    # (B, C)
 
     if task_is_classification:
         yc = y_ref[0]                   # (B, 1) int32
@@ -137,13 +150,14 @@ def _p_epoch_kernel(
 
 @functools.lru_cache(maxsize=64)
 def make_pallas_p_epoch(task: str, C: int, J: int, B: int, S: int,
-                        interpret: bool = False):
+                        interpret: bool = False, nt: bool = False):
     """Build ``p_epoch(p (1,J), buf (1,J), cv (1,J), lb (S,C,B,J),
     yb (S,B,1), bv (S,B,1), scal (2,)) -> (p, buf, metrics (3,))`` — one
     full shuffled pass over the pooled validation set as one fused
-    Pallas program. ``scal`` packs (lr_p, momentum)."""
+    Pallas program. ``scal`` packs (lr_p, momentum). ``nt`` selects the
+    reshape-free forward (see the flag on ``_p_epoch_kernel``)."""
     kernel = functools.partial(
-        _p_epoch_kernel, task == "classification", C, J, B
+        _p_epoch_kernel, task == "classification", C, J, B, nt
     )
     y_dtype = jnp.int32 if task == "classification" else jnp.float32
 

@@ -26,11 +26,13 @@ def _mk(task, n_val, J, C, seed=0):
     return logits, y, p
 
 
+@pytest.mark.parametrize("impl", ["pallas_interpret",
+                                  "pallas_nt_interpret"])
 @pytest.mark.parametrize("task,C", [("classification", 3),
                                     ("classification", 2),
                                     ("regression", 1)])
 @pytest.mark.parametrize("momentum", [0.9, 0.0])
-def test_pallas_solver_matches_xla(task, C, momentum):
+def test_pallas_solver_matches_xla(task, C, momentum, impl):
     n_val, J, B = 53, 7, 16  # last batch partial (53 = 3*16 + 5)
     logits, y, p0 = _mk(task, n_val, J, C)
     key = jax.random.PRNGKey(42)
@@ -38,7 +40,7 @@ def test_pallas_solver_matches_xla(task, C, momentum):
     sx, ix = make_p_solver(task, n_val, B, 5e-3, momentum,
                            kernel_impl="xla")
     sp, ip = make_p_solver(task, n_val, B, 5e-3, momentum,
-                           kernel_impl="pallas_interpret")
+                           kernel_impl=impl)
     px, ox, lx, ax = sx(logits, y, p0, ix(p0), key, 3)
     pp, op, lp, ap = sp(logits, y, p0, ip(p0), key, 3)
 
@@ -97,6 +99,8 @@ def test_resolve_psolver_impl(monkeypatch):
     assert resolve_psolver_impl("pallas") == "pallas"
     monkeypatch.setenv("FEDAMW_PSOLVER", "pallas")
     assert resolve_psolver_impl("auto") == "pallas"
+    monkeypatch.setenv("FEDAMW_PSOLVER", "pallas_nt")
+    assert resolve_psolver_impl("auto") == "pallas_nt"
     monkeypatch.setenv("FEDAMW_PSOLVER", "xla")
     assert resolve_psolver_impl("auto") == "xla"
     monkeypatch.delenv("FEDAMW_PSOLVER")

@@ -57,9 +57,10 @@ def test_epoch_kernel_lowers_and_matches_interpret(layout):
     np.testing.assert_allclose(met, np.asarray(met_i), rtol=1e-4)
 
 
+@pytest.mark.parametrize("impl", ["pallas", "pallas_nt"])
 @pytest.mark.parametrize("task,C", [("classification", 2),
                                     ("regression", 1)])
-def test_psolver_kernel_lowers_and_matches_xla(task, C):
+def test_psolver_kernel_lowers_and_matches_xla(task, C, impl):
     from fedamw_tpu.fedcore.aggregate import make_p_solver
 
     n_val, J, B = 253, 64, 16
@@ -75,7 +76,7 @@ def test_psolver_kernel_lowers_and_matches_xla(task, C):
     key = jax.random.PRNGKey(3)
 
     sx, ix = make_p_solver(task, n_val, B, 5e-3, 0.9, kernel_impl="xla")
-    sp, ip = make_p_solver(task, n_val, B, 5e-3, 0.9, kernel_impl="pallas")
+    sp, ip = make_p_solver(task, n_val, B, 5e-3, 0.9, kernel_impl=impl)
     px = np.asarray(sx(logits, y, p0, ix(p0), key, 3)[0])
     pp = np.asarray(sp(logits, y, p0, ip(p0), key, 3)[0])
     np.testing.assert_allclose(pp, px, rtol=1e-4, atol=1e-6)
