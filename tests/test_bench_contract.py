@@ -85,6 +85,33 @@ def test_bench_cpu_fallback_contract():
     assert "vs_baseline" not in lines[1]  # no baseline arm in fallback
 
 
+def test_bench_sweep_only_contract():
+    """BENCH_SWEEP_ONLY (tpu_window.sh step 4/4) must emit exactly one
+    JSON line — the bucket sweep — and skip every other leg, so the
+    window's sweep step never re-times what earlier steps harvested."""
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu", BENCH_NO_PROBE="1", BENCH_SWEEP_ONLY="1",
+        BENCH_SWEEP_BUCKETS="4,8",
+        BENCH_CLIENTS="8", BENCH_D="64", BENCH_ROUNDS="2",
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=570,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [json.loads(ln) for ln in out.stdout.splitlines() if ln]
+    assert len(lines) == 1
+    rec = lines[0]
+    assert rec["metric"] == "bucket_sweep_updates_per_sec"
+    assert set(rec["buckets"]) == {"4", "8"}
+    assert rec["value"] == max(rec["buckets"].values())
+    assert rec["platform"] == "cpu"
+    # no other legs ran (their stderr banners are absent)
+    assert "torch-cpu" not in out.stderr
+    assert "reference-loop" not in out.stderr
+
+
 def test_dryrun_multichip_succeeds_without_backend_query():
     """`python -c "import __graft_entry__ as g; g.dryrun_multichip(4)"`
     completes via the respawn-first path (no respawn-skip vars set).
