@@ -35,16 +35,24 @@ to the repo-torch arm (conservative: it is faster than the reference).
 When the accelerator is unreachable (wedged remote tunnel), the bench
 falls back to CPU instead of aborting metric-less: every JSON line
 carries a "platform" field, so a CPU-vs-CPU capture is clearly labeled
-(BENCH_STRICT_TPU=1 restores the hard abort). The fallback trims to
-the headline only — 5 rounds, no FedAMW leg (BENCH_ROUNDS /
-BENCH_CPU_FALLBACK_FULL=1 override) — so the JSON lands well before
-any driver-side wall-clock cap.
+(BENCH_STRICT_TPU=1 restores the hard abort; BENCH_FORCE_FALLBACK=1
+skips the 180 s probe when the tunnel is known-down). The fallback
+trims for wall-clock — 5 rounds, reference arm skipped, FedAMW as a
+JAX-only leg when the compile cache is warm — and prints the headline
+both FIRST (kill-safety) and LAST (the driver parses the final JSON
+line). Headline lines carry flops_per_update/achieved_gflops
+(PERFORMANCE.md § MFU). On TPU, bench_jax_best auto-times the XLA path
+against both Pallas layout pairs (row/reshape defaults, then the
+pallas_col/pallas_nt lowering hedges, mixed pairs on failure) and
+labels the winner in "impl". BENCH_SWEEP_BUCKETS="8,16,32,64" appends
+a bucket-count sweep line; BENCH_SWEEP_ONLY=1 emits only it.
 
 Env overrides: BENCH_CLIENTS (default 256), BENCH_ROUNDS (default 20),
 BENCH_D (default 2000), BENCH_TORCH_ROUNDS (default 2), BENCH_BUCKETS
 (default 32), BENCH_AMW_TORCH_ROUNDS (default 2), BENCH_REF_ROUNDS /
 BENCH_AMW_REF_ROUNDS (default 2), BENCH_NO_REFERENCE (skip the
-reference arm), BENCH_PROFILE
+reference arm), BENCH_NO_PALLAS, BENCH_FALLBACK_AMW=1/0,
+BENCH_CPU_FALLBACK_FULL=1, BENCH_PROFILE
 (set to a directory to capture a jax.profiler trace of the timed run).
 """
 
