@@ -130,22 +130,30 @@ def bench_jax_best(ds, D, rounds, algorithm="FedAvg", **kw):
     the kernel is wrong, not "different"). Returns
     (updates/s, acc, seconds, impl_label).
     """
-    xla = bench_jax(ds, D, rounds, algorithm=algorithm, **kw)
-    best = (*xla, "xla")
-    if os.environ.get("BENCH_NO_PALLAS"):
-        return best
-    import jax
-
-    from fedamw_tpu.fedcore.client import _TPU_BACKENDS
-
-    if jax.default_backend() not in _TPU_BACKENDS:
-        # off-TPU the client kernel silently falls back to XLA, so a
-        # "pallas" candidate would just re-time the XLA program (and
-        # mislabel the winner); the fused kernels are a TPU play only
-        return best
     saved = {k: os.environ.get(k) for k in ("FEDAMW_KERNEL",
                                             "FEDAMW_PSOLVER")}
     try:
+        # pin the baseline leg: 'auto' now resolves to pallas on TPU,
+        # so an unpinned first leg would silently run the pallas
+        # kernels and blind the cross-check (both legs identical)
+        os.environ["FEDAMW_KERNEL"] = "xla"
+        os.environ["FEDAMW_PSOLVER"] = "xla"
+        xla = bench_jax(ds, D, rounds, algorithm=algorithm, **kw)
+        best = (*xla, "xla")
+        print(f"# {algorithm} leg xla: {xla[0]:.1f} updates/s "
+              f"(acc {xla[1]:.2f})", file=sys.stderr)
+        if os.environ.get("BENCH_NO_PALLAS"):
+            return best
+        import jax
+
+        from fedamw_tpu.fedcore.client import _TPU_BACKENDS
+
+        if jax.default_backend() not in _TPU_BACKENDS:
+            # off-TPU the client kernel silently falls back to XLA, so
+            # a "pallas" candidate would just re-time the XLA program
+            # (and mislabel the winner); the fused kernels are a TPU
+            # play only
+            return best
         # layout pairs: the default row/reshape kernels first, then the
         # transpose-free hedges (pallas_col epoch kernel + pallas_nt
         # p-solver) built for the kernels' audited Mosaic-lowering
@@ -153,18 +161,28 @@ def bench_jax_best(ds, D, rounds, algorithm="FedAvg", **kw):
         # accuracy discard), the mixed pairs are also tried — a valid
         # (pallas, pallas_nt) combo must not be lost just because its
         # pair-mates each broke one leg. Fastest valid pair wins.
-        pairs = [("pallas", "pallas"), ("pallas_col", "pallas_nt"),
-                 ("pallas", "pallas_nt"), ("pallas_col", "pallas")]
+        main = [("pallas", "pallas"), ("pallas_col", "pallas_nt")]
+        if algorithm == "FedAMW":
+            # isolate the p-solver's contribution: the round-4 window
+            # measured pallas+pallas > xla+xla for FedAMW while the
+            # FedAvg leg showed the epoch kernel alone losing to XLA,
+            # so the mixed xla-epoch + pallas-psolver pair (the 'auto'
+            # default since that window) is a first-class candidate
+            main.insert(1, ("xla", "pallas"))
+        fb = [("pallas", "pallas_nt"), ("pallas_col", "pallas")]
         failed = False
-        for i, (kern, psolv) in enumerate(pairs):
-            if i >= 2 and (not failed or algorithm != "FedAMW"):
-                # both diagonals lowered, or the algorithm never runs
+        for i, (kern, psolv) in enumerate(main + fb):
+            if i >= len(main) and (not failed or algorithm != "FedAMW"):
+                # every main pair lowered, or the algorithm never runs
                 # the p-solver (mixed pairs would just re-time kernels)
                 break
             try:
                 os.environ["FEDAMW_KERNEL"] = kern
                 os.environ["FEDAMW_PSOLVER"] = psolv
                 cand = bench_jax(ds, D, rounds, algorithm=algorithm, **kw)
+                print(f"# {algorithm} leg {kern}+{psolv}: "
+                      f"{cand[0]:.1f} updates/s (acc {cand[1]:.2f})",
+                      file=sys.stderr)
                 if abs(cand[1] - xla[1]) > 0.5:
                     print(f"# {algorithm} {kern}+{psolv} leg acc "
                           f"{cand[1]:.2f} != xla {xla[1]:.2f}; "

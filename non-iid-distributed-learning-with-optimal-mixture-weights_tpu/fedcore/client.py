@@ -113,11 +113,15 @@ def resolve_kernel_impl(kernel_impl: str, params,
         ):
             return kernel_impl
         return "xla"
-    # auto currently resolves to the XLA kernel even on TPU: the Pallas
-    # path is numerically pinned against it in interpreter mode
-    # (tests/test_pallas_kernel.py) but not yet validated on the axon
-    # remote-attach lowering — opt in with FEDAMW_KERNEL=pallas or an
-    # explicit kernel_impl until that validation lands.
+    # Measured decision (round-4 hardware window, tpu_artifacts/
+    # bench.json): at the FedAvg headline — a pure epoch-kernel
+    # workload — the XLA scan beat the fused Pallas epoch kernel
+    # (winner impl "xla"; the pallas leg lowered, matched accuracy,
+    # and was slower), so 'auto' keeps resolving to XLA here. The
+    # p-solver is the opposite case — its fused kernel was in the
+    # measured FedAMW winner — and its 'auto' prefers Pallas on TPU
+    # (see aggregate.resolve_psolver_impl). bench.py auto-times every
+    # impl each window, so this decision is re-checked per artifact.
     return "xla"
 
 

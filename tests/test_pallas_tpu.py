@@ -79,7 +79,16 @@ def test_psolver_kernel_lowers_and_matches_xla(task, C, impl):
     sp, ip = make_p_solver(task, n_val, B, 5e-3, 0.9, kernel_impl=impl)
     px = np.asarray(sx(logits, y, p0, ix(p0), key, 3)[0])
     pp = np.asarray(sp(logits, y, p0, ip(p0), key, 3)[0])
-    np.testing.assert_allclose(pp, px, rtol=1e-4, atol=1e-6)
+    # On hardware the XLA arm and the Mosaic kernel tile the einsum
+    # contractions differently under the TPU's default (bf16-input)
+    # matmul precision, so they are two different roundings of the
+    # same math — the divergence compounds over the 3 SGD epochs.
+    # Round-4 window measured max|diff| <= 4.6e-4 across all four
+    # parametrizations (tpu_artifacts/pallas.log); bound it at ~4x
+    # that. Exact-match parity is pinned in interpreter mode
+    # (test_pallas_psolver.py, rtol=1e-4/atol=1e-6), where both paths
+    # use identical f32 arithmetic.
+    np.testing.assert_allclose(pp, px, rtol=2e-2, atol=2e-3)
 
 
 def test_fedamw_e2e_with_pallas_kernels(monkeypatch):

@@ -14,7 +14,10 @@ while :; do
   if timeout 120 python -c \
       "import numpy, jax.numpy as jnp; numpy.asarray(jnp.ones(2)+1); print('TUNNEL_UP')"; then
     echo "[$(date -u +%H:%M:%S)] tunnel up — harvesting into $OUT/"
-    bash tpu_window.sh "$OUT"
+    # resume mode: skip steps a previous window already completed
+    # (each drops a <step>.ok marker), so a revival spends its time
+    # on what is still missing
+    TPU_RESUME=${TPU_RESUME:-1} bash tpu_window.sh "$OUT"
     rc=$?
     # commit whatever landed even on partial harvest (a mid-window
     # wedge still leaves the earlier steps' artifacts)
