@@ -97,12 +97,18 @@ def resolve_psolver_impl(kernel_impl: str = "auto") -> str:
 
     Mirrors ``client.resolve_kernel_impl``: FEDAMW_PSOLVER overrides an
     'auto' argument; otherwise 'auto' resolves to the Pallas kernel on
-    TPU backends — hardware-validated and measured faster than XLA in
-    the round-4 window (tpu_artifacts/bench.json, winner impl
-    "pallas+pallas" with the accuracy cross-check) — and to XLA
-    everywhere else (the interpret-mode kernels are test vehicles, far
-    slower than XLA on CPU). Oversized validation sets still fall back
-    to the XLA path inside ``_make_pallas_solve`` (epoch-gather limit).
+    TPU backends, and to XLA everywhere else (the interpret-mode
+    kernels are test vehicles, far slower than XLA on CPU). Evidence
+    basis (round-4 window, tpu_artifacts/bench.json): the FedAMW
+    winner was the pallas+pallas PAIR — the p-solver kernel was only
+    timed together with the Pallas epoch kernel, while the FedAvg leg
+    showed that epoch kernel alone losing to XLA, so attributing the
+    pair's win to the p-solver is an inference, not yet an isolated
+    measurement. ``bench_jax_best`` now times the mixed xla+pallas
+    pair (this default) each window, so the next artifact either
+    confirms or reverses this choice. Oversized validation sets still
+    fall back to the XLA path inside ``_make_pallas_solve``
+    (epoch-gather limit).
     """
     import os
 

@@ -22,7 +22,7 @@ while :; do
     # commit whatever landed even on partial harvest (a mid-window
     # wedge still leaves the earlier steps' artifacts)
     git add -A "$OUT" 2>/dev/null
-    git commit -m "TPU window harvest: bench/pallas/scale artifacts (rc=$rc)" \
+    git commit -m "TPU window harvest: bench/pallas/scale/sweep/exp artifacts (rc=$rc)" \
       -- "$OUT" 2>/dev/null || echo "nothing new to commit"
     exit $rc
   fi

@@ -25,14 +25,18 @@ RESUME=${TPU_RESUME:-0}
 mkdir -p "$OUT"
 stamp() { date -u +%H:%M:%S; }
 probe() {
-  timeout 120 python -c "import numpy, jax.numpy as jnp; numpy.asarray(jnp.ones(2)+1); print('TUNNEL_UP')" \
+  # asserts the real TPU backend, not just a working jax: a silent
+  # CPU fallback must not let a CPU run be harvested as TPU evidence
+  timeout 120 python -c "import jax, numpy, jax.numpy as jnp; \
+assert jax.default_backend() in ('tpu', 'axon'), jax.default_backend(); \
+numpy.asarray(jnp.ones(2)+1); print('TUNNEL_UP')" \
     || { echo "[$(stamp)] tunnel down; stopping (artifacts so far in $OUT/)"; exit 1; }
 }
 skip() { [ "$RESUME" = 1 ] && [ -e "$OUT/$1.ok" ]; }
 
 echo "[$(stamp)] probe"; probe
 
-echo "[$(stamp)] 1/4 bench.py (headline; auto xla-vs-pallas; never skipped)"
+echo "[$(stamp)] 1/5 bench.py (headline; auto xla-vs-pallas; never skipped)"
 # STRICT: this script exists to harvest REAL-chip numbers; if the
 # tunnel dies mid-step, abort fast (bench.py's default CPU fallback is
 # for the driver's unattended capture, not for this window)
@@ -41,8 +45,8 @@ rc=$?; echo "rc=$rc bench"
 tail -2 "$OUT/bench.json" 2>/dev/null
 
 echo "[$(stamp)] probe"; probe
-if skip pallas; then echo "[$(stamp)] 2/4 pallas tier: already green, skipping"; else
-echo "[$(stamp)] 2/4 pallas hardware tier"
+if skip pallas; then echo "[$(stamp)] 2/5 pallas tier: already green, skipping"; else
+echo "[$(stamp)] 2/5 pallas hardware tier"
 FEDAMW_TEST_PLATFORM=tpu timeout 1200 python -m pytest tests/test_pallas_tpu.py -q \
   >"$OUT/pallas.log" 2>&1
 rc=$?; echo "rc=$rc pallas"; [ $rc -eq 0 ] && touch "$OUT/pallas.ok"
@@ -50,16 +54,16 @@ tail -3 "$OUT/pallas.log"
 fi
 
 echo "[$(stamp)] probe"; probe
-if skip scale; then echo "[$(stamp)] 3/4 scale: already green, skipping"; else
-echo "[$(stamp)] 3/4 scale_bench.py"
+if skip scale; then echo "[$(stamp)] 3/5 scale: already green, skipping"; else
+echo "[$(stamp)] 3/5 scale_bench.py"
 timeout 1800 python scale_bench.py >"$OUT/scale.json" 2>"$OUT/scale.log"
 rc=$?; echo "rc=$rc scale"; [ $rc -eq 0 ] && touch "$OUT/scale.ok"
 tail -2 "$OUT/scale.json" 2>/dev/null
 fi
 
 echo "[$(stamp)] probe"; probe
-if skip bucket_sweep; then echo "[$(stamp)] 4/4 sweep: already green, skipping"; else
-echo "[$(stamp)] 4/4 bucket sweep (op-overhead-bound workload: where is"
+if skip bucket_sweep; then echo "[$(stamp)] 4/5 sweep: already green, skipping"; else
+echo "[$(stamp)] 4/5 bucket sweep (op-overhead-bound workload: where is"
 echo "          the padding-vs-dispatch optimum on real hardware?)"
 # BENCH_SWEEP_ONLY skips the headline/torch/reference/FedAMW legs the
 # earlier steps already harvested — the 1200 s cap covers only the 4
@@ -69,6 +73,30 @@ BENCH_STRICT_TPU=1 BENCH_SWEEP_ONLY=1 BENCH_SWEEP_BUCKETS="8,16,32,64" \
   >"$OUT/bucket_sweep.json" 2>"$OUT/bucket_sweep.log"
 rc=$?; echo "rc=$rc sweep"; [ $rc -eq 0 ] && touch "$OUT/bucket_sweep.ok"
 grep bucket_sweep "$OUT/bucket_sweep.json" 2>/dev/null
+fi
+
+echo "[$(stamp)] probe"; probe
+if skip exp_tpu; then echo "[$(stamp)] 5/5 exp.py: already green, skipping"; else
+echo "[$(stamp)] 5/5 exp.py full defaults on the chip (the reference's"
+echo "          own experiment — J=50, alpha=0.01, D=2000, 100 rounds,"
+echo "          all 6 algorithms x 5 repeats — as a timed TPU artifact;"
+echo "          CPU takes ~120 s/repeat, RESULTS.md)"
+# same-process backend assert: the probe can't see a CPU fallback
+# inside THIS process, and a CPU run must never be committed as a
+# TPU artifact (mirrors bench.py's BENCH_STRICT_TPU)
+{ time timeout 1800 python -c "
+import jax, runpy, sys
+assert jax.default_backend() in ('tpu', 'axon'), jax.default_backend()
+print('exp.py on backend:', jax.default_backend())
+sys.argv = ['exp.py', '--dataset', 'digits', '--n_repeats', '5']
+runpy.run_path('exp.py', run_name='__main__')
+" ; } >"$OUT/exp_tpu.log" 2>&1
+rc=$?; echo "rc=$rc exp"
+if [ $rc -eq 0 ] && [ -f results/exp1_digits.pkl ]; then
+  cp results/exp1_digits.pkl "$OUT/exp1_digits_tpu.pkl"
+  touch "$OUT/exp_tpu.ok"
+fi
+tail -4 "$OUT/exp_tpu.log"
 fi
 
 echo "[$(stamp)] done -> $OUT/"
