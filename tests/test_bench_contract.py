@@ -132,3 +132,69 @@ def test_dryrun_multichip_succeeds_without_backend_query():
     )
     assert out.returncode == 0, (out.stdout + out.stderr)[-2000:]
     assert "dryrun_multichip(4): OK" in out.stdout
+
+
+def test_bench_jax_best_leg_policy(monkeypatch):
+    """The in-process contract of bench_jax_best after the round-4
+    kernel-default flip: the baseline leg must run with both impl env
+    vars pinned to xla (an unpinned leg would resolve 'auto' to pallas
+    on TPU and blind the accuracy cross-check), the FedAMW candidate
+    list must include the mixed xla+pallas pair (the 'auto' default,
+    so each window measures it), the fastest accuracy-matching pair
+    must win, and the caller's env must be restored."""
+    import bench as bench_mod
+
+    calls = []
+    speed = {
+        ("xla", "xla"): 100.0,
+        ("pallas", "pallas"): 140.0,
+        ("xla", "pallas"): 160.0,
+        ("pallas_col", "pallas_nt"): 90.0,
+    }
+
+    def fake_bench_jax(ds, D, rounds, algorithm="FedAvg", **kw):
+        pair = (os.environ["FEDAMW_KERNEL"], os.environ["FEDAMW_PSOLVER"])
+        calls.append(pair)
+        return speed[pair], 97.5, 1.0
+
+    monkeypatch.setattr(bench_mod, "bench_jax", fake_bench_jax)
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setenv("FEDAMW_KERNEL", "caller-sentinel")
+    monkeypatch.delenv("FEDAMW_PSOLVER", raising=False)
+    monkeypatch.delenv("BENCH_NO_PALLAS", raising=False)
+
+    ups, acc, dt, impl = bench_mod.bench_jax_best(
+        None, 64, 2, algorithm="FedAMW")
+    assert calls[0] == ("xla", "xla")  # pinned baseline leg
+    assert ("xla", "pallas") in calls  # the auto default is measured
+    assert impl == "xla+pallas" and ups == 160.0
+    # caller env restored exactly
+    assert os.environ["FEDAMW_KERNEL"] == "caller-sentinel"
+    assert "FEDAMW_PSOLVER" not in os.environ
+
+    # accuracy-mismatched candidates are discarded even when faster
+    calls.clear()
+
+    def fake_bad_acc(ds, D, rounds, algorithm="FedAvg", **kw):
+        pair = (os.environ["FEDAMW_KERNEL"], os.environ["FEDAMW_PSOLVER"])
+        calls.append(pair)
+        if pair == ("xla", "xla"):
+            return 100.0, 97.5, 1.0
+        return 500.0, 42.0, 1.0
+
+    monkeypatch.setattr(bench_mod, "bench_jax", fake_bad_acc)
+    ups, acc, dt, impl = bench_mod.bench_jax_best(
+        None, 64, 2, algorithm="FedAMW")
+    assert impl == "xla" and ups == 100.0
+
+    # FedAvg: p-solver never runs -> only the diagonal epoch-kernel
+    # candidates, no mixed pairs, label is the kernel name alone
+    calls.clear()
+    monkeypatch.setattr(bench_mod, "bench_jax", fake_bench_jax)
+    ups, acc, dt, impl = bench_mod.bench_jax_best(
+        None, 64, 2, algorithm="FedAvg")
+    assert calls[0] == ("xla", "xla")
+    assert ("xla", "pallas") not in calls
+    assert impl == "pallas" and ups == 140.0

@@ -30,7 +30,29 @@ probe() {
   timeout 120 python -c "import jax, numpy, jax.numpy as jnp; \
 assert jax.default_backend() in ('tpu', 'axon'), jax.default_backend(); \
 numpy.asarray(jnp.ones(2)+1); print('TUNNEL_UP')" \
-    || { echo "[$(stamp)] tunnel down; stopping (artifacts so far in $OUT/)"; exit 1; }
+    || { echo "[$(stamp)] probe failed (tunnel down or non-TPU backend; see assert above); stopping (artifacts so far in $OUT/)"; exit 1; }
+}
+# wrap a python entrypoint so it asserts the TPU backend in ITS OWN
+# process — the probe cannot see a CPU fallback inside a later process,
+# and a CPU run must never be harvested as TPU evidence (mirrors
+# bench.py's BENCH_STRICT_TPU)
+strict_py() {  # strict_py <timeout-s> <script.py> [args...]
+  # (timeout lives inside: `timeout` cannot run a shell function)
+  local cap=$1 script=$2; shift 2
+  timeout "$cap" python -c "
+import os, sys, runpy
+import jax
+# mirror the entrypoints' own platform handling (the axon plugin
+# latches jax_platforms at interpreter start, so the env var only
+# takes effect via config.update) — a leaked JAX_PLATFORMS=cpu must
+# fail the assert here, not silently downgrade the script's backend
+if os.environ.get('JAX_PLATFORMS'):
+    jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+assert jax.default_backend() in ('tpu', 'axon'), jax.default_backend()
+print('$script on backend:', jax.default_backend(), file=sys.stderr)
+sys.argv = ['$script'] + sys.argv[1:]
+runpy.run_path('$script', run_name='__main__')
+" "$@"
 }
 skip() { [ "$RESUME" = 1 ] && [ -e "$OUT/$1.ok" ]; }
 
@@ -56,7 +78,7 @@ fi
 echo "[$(stamp)] probe"; probe
 if skip scale; then echo "[$(stamp)] 3/5 scale: already green, skipping"; else
 echo "[$(stamp)] 3/5 scale_bench.py"
-timeout 1800 python scale_bench.py >"$OUT/scale.json" 2>"$OUT/scale.log"
+strict_py 1800 scale_bench.py >"$OUT/scale.json" 2>"$OUT/scale.log"
 rc=$?; echo "rc=$rc scale"; [ $rc -eq 0 ] && touch "$OUT/scale.ok"
 tail -2 "$OUT/scale.json" 2>/dev/null
 fi
@@ -81,16 +103,8 @@ echo "[$(stamp)] 5/5 exp.py full defaults on the chip (the reference's"
 echo "          own experiment — J=50, alpha=0.01, D=2000, 100 rounds,"
 echo "          all 6 algorithms x 5 repeats — as a timed TPU artifact;"
 echo "          CPU takes ~120 s/repeat, RESULTS.md)"
-# same-process backend assert: the probe can't see a CPU fallback
-# inside THIS process, and a CPU run must never be committed as a
-# TPU artifact (mirrors bench.py's BENCH_STRICT_TPU)
-{ time timeout 1800 python -c "
-import jax, runpy, sys
-assert jax.default_backend() in ('tpu', 'axon'), jax.default_backend()
-print('exp.py on backend:', jax.default_backend())
-sys.argv = ['exp.py', '--dataset', 'digits', '--n_repeats', '5']
-runpy.run_path('exp.py', run_name='__main__')
-" ; } >"$OUT/exp_tpu.log" 2>&1
+{ time strict_py 1800 exp.py --dataset digits --n_repeats 5 ; } \
+  >"$OUT/exp_tpu.log" 2>&1
 rc=$?; echo "rc=$rc exp"
 if [ $rc -eq 0 ] && [ -f results/exp1_digits.pkl ]; then
   cp results/exp1_digits.pkl "$OUT/exp1_digits_tpu.pkl"
