@@ -24,7 +24,21 @@ while :; do
     git add -A "$OUT" 2>/dev/null
     git commit -m "TPU window harvest: bench/pallas/scale/sweep/exp artifacts (rc=$rc)" \
       -- "$OUT" 2>/dev/null || echo "nothing new to commit"
-    exit $rc
+    # done only when every step is green, INCLUDING this window's bench
+    # (bench.ok is cleared and re-dropped by tpu_window.sh each window,
+    # so it certifies the current window's bench, not a stale one);
+    # a partial window keeps the watcher polling for the next one —
+    # without consuming the down-tunnel retry budget or mislabeling
+    # the state, hence the separate branch
+    if [ -e "$OUT/bench.ok" ] && [ -e "$OUT/pallas.ok" ] \
+        && [ -e "$OUT/scale.ok" ] && [ -e "$OUT/bucket_sweep.ok" ] \
+        && [ -e "$OUT/exp_tpu.ok" ]; then
+      echo "[$(date -u +%H:%M:%S)] all steps green — watcher done"
+      exit 0
+    fi
+    echo "[$(date -u +%H:%M:%S)] partial harvest (rc=$rc); tunnel was up — retry in ${INTERVAL}s"
+    sleep "$INTERVAL"
+    continue
   fi
   n=$((n + 1))
   if [ "$MAX" -gt 0 ] && [ "$n" -ge "$MAX" ]; then

@@ -62,8 +62,13 @@ echo "[$(stamp)] 1/5 bench.py (headline; auto xla-vs-pallas; never skipped)"
 # STRICT: this script exists to harvest REAL-chip numbers; if the
 # tunnel dies mid-step, abort fast (bench.py's default CPU fallback is
 # for the driver's unattended capture, not for this window)
+# bench.ok is a THIS-window success indicator, not resume state:
+# cleared up front so the watcher's all-green check can't be satisfied
+# by a stale marker from an earlier window while this window's bench
+# failed (skip() never consults it — the bench always re-runs)
+rm -f "$OUT/bench.ok"
 BENCH_STRICT_TPU=1 timeout 1200 python bench.py >"$OUT/bench.json" 2>"$OUT/bench.log"
-rc=$?; echo "rc=$rc bench"
+rc=$?; echo "rc=$rc bench"; [ $rc -eq 0 ] && touch "$OUT/bench.ok"
 tail -2 "$OUT/bench.json" 2>/dev/null
 
 echo "[$(stamp)] probe"; probe
