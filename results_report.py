@@ -24,24 +24,57 @@ def final_acc(res):
     return np.asarray(res["test_acc"])[:, -1, :]
 
 
+def is_regression(res):
+    """Regression artifacts carry acc==0.0 everywhere (the accuracy
+    metric is classification-only; ``fedcore/evaluate.py``) — the
+    meaningful final metric is then test_loss (MSE, lower better)."""
+    return bool(np.allclose(np.asarray(res["test_acc"]), 0.0))
+
+
 def render_markdown(res):
-    acc = final_acc(res)
     names = list(res["name"])
-    best = int(np.argmax(acc.mean(axis=1)))
+    if is_regression(res):
+        # lower-is-better: rank by final test MSE; reuse the reference's
+        # t-test by negating (check_significance asks "does best beat
+        # row", defined on higher-is-better arrays)
+        met = np.asarray(res["test_loss"])[:, -1, :]
+        means = np.where(np.all(np.isfinite(met), axis=1),
+                         met.mean(axis=1), np.inf)
+        best = int(np.argmin(means))
+        sig = lambda row: check_significance(-row, -met[best])
+        head = f"final test MSE (mean±std over {met.shape[1]} repeats)"
+        fmt = "{:.4f}±{:.4f}"
+    else:
+        met = final_acc(res)
+        means = np.where(np.all(np.isfinite(met), axis=1),
+                         met.mean(axis=1), -np.inf)
+        best = int(np.argmax(means))
+        sig = lambda row: check_significance(row, met[best])
+        head = f"final test acc (mean±std over {met.shape[1]} repeats)"
+        fmt = "{:.2f}±{:.2f}"
     lines = [
-        "| Algorithm | final test acc (mean±std over "
-        f"{acc.shape[1]} repeats) | vs best |",
+        f"| Algorithm | {head} | vs best |",
         "|---|---|---|",
     ]
     for i, name in enumerate(names):
-        row = acc[i]
+        row = met[i]
+        if not np.all(np.isfinite(row)):
+            # a diverged run can never be best; count the blowups
+            bad = int(np.sum(~np.isfinite(row)))
+            fin = row[np.isfinite(row)]
+            shown = (fmt.format(fin.mean(), fin.std())
+                     if fin.size else "—")
+            lines.append(f"| {name} | {shown} "
+                         f"| diverged (non-finite in {bad}/{row.size} "
+                         "repeats) |")
+            continue
         if i == best:
             mark = "**best**"
-        elif check_significance(row, acc[best]):
+        elif sig(row):
             mark = "significantly worse"
         else:
             mark = "not significantly worse"
-        lines.append(f"| {name} | {row.mean():.2f}±{row.std():.2f} "
+        lines.append(f"| {name} | {fmt.format(row.mean(), row.std())} "
                      f"| {mark} |")
     het = np.asarray(res["heterogeneity"])
     lines.append("")
@@ -57,7 +90,9 @@ def main():
                     help="markdown table instead of the LaTeX row")
     args = ap.parse_args()
     res = load_results(args.pkl)
-    if args.markdown:
+    if args.markdown or is_regression(res):
+        # the reference's LaTeX emitter assumes accuracy (best=max);
+        # regression artifacts always render the markdown MSE table
         print(render_markdown(res))
     else:
         # the reference's exact emitter (best bold / underline rule)

@@ -72,3 +72,40 @@ def test_exp_driver_extension_flags(tmp_path):
     with open(tmp_path / "exp1_digits.pkl", "rb") as f:
         data = pickle.load(f)
     assert data["test_acc"].shape == (6, 3, 1)
+
+
+def test_results_report_regression_mode():
+    """Regression artifacts (acc==0 everywhere; fedcore/evaluate.py)
+    are rendered as a final-test-MSE table with best = LOWEST loss and
+    the reference t-test applied on the negated (higher-is-better)
+    values — the classification path stays argmax-on-accuracy."""
+    import results_report as rr
+
+    names = ["CL", "DL", "FedAMW_OneShot", "FedAvg", "FedProx", "FedAMW"]
+    rng = np.random.RandomState(0)
+    loss = np.abs(rng.randn(6, 4, 5)) + 1.0
+    loss[5] = 0.01  # FedAMW: clearly lowest MSE
+    res = {
+        "name": names,
+        "train_loss": loss,
+        "test_loss": loss,
+        "test_acc": np.zeros((6, 4, 5)),
+        "heterogeneity": np.zeros(5),
+        "epochs": 4,
+    }
+    assert rr.is_regression(res)
+    md = rr.render_markdown(res)
+    assert "final test MSE" in md
+    best_rows = [ln for ln in md.splitlines() if "**best**" in ln]
+    assert len(best_rows) == 1 and best_rows[0].startswith("| FedAMW ")
+    # a clearly-worse constant row is flagged by the t-test
+    dl_row = [ln for ln in md.splitlines() if ln.startswith("| DL ")][0]
+    assert "significantly worse" in dl_row
+
+    res["test_acc"] = np.full((6, 4, 5), 50.0)
+    res["test_acc"][0] = 99.0  # CL best on accuracy
+    assert not rr.is_regression(res)
+    md = rr.render_markdown(res)
+    assert "final test acc" in md
+    best_rows = [ln for ln in md.splitlines() if "**best**" in ln]
+    assert len(best_rows) == 1 and best_rows[0].startswith("| CL ")

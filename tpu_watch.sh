@@ -4,7 +4,8 @@
 # so a short, unattended tunnel window is never wasted (the round-3
 # review: "tpu_window.sh only runs if a human happens to be watching").
 # Usage: bash tpu_watch.sh [outdir]   (env: TPU_WATCH_INTERVAL seconds,
-# default 600; TPU_WATCH_MAX_POLLS caps the loop, default unbounded)
+# default 600; TPU_WATCH_MAX_POLLS caps the loop — down-tunnel polls
+# and up-tunnel partial-harvest retries both count — default unbounded)
 set -u
 OUT=${1:-tpu_artifacts}
 INTERVAL=${TPU_WATCH_INTERVAL:-600}
@@ -36,7 +37,12 @@ while :; do
       echo "[$(date -u +%H:%M:%S)] all steps green — watcher done"
       exit 0
     fi
-    echo "[$(date -u +%H:%M:%S)] partial harvest (rc=$rc); tunnel was up — retry in ${INTERVAL}s"
+    n=$((n + 1))
+    if [ "$MAX" -gt 0 ] && [ "$n" -ge "$MAX" ]; then
+      echo "[$(date -u +%H:%M:%S)] giving up after $n polls (last window partial, rc=$rc)"
+      exit 1
+    fi
+    echo "[$(date -u +%H:%M:%S)] partial harvest (rc=$rc); tunnel was up — retry in ${INTERVAL}s (poll $n)"
     sleep "$INTERVAL"
     continue
   fi

@@ -50,7 +50,7 @@ def get_params():
     return args
 
 
-def main(args):
+def main(args, metrics_out=None):
     if os.environ.get("JAX_PLATFORMS"):
         # honor the env var even under this container's sitecustomize,
         # which force-registers the axon TPU plugin (the config update
@@ -98,6 +98,12 @@ def main(args):
     loss = float(res["test_loss"][-1])
     logger.info("FedAMW --- Error: %.5f Acc: %.5f", loss, acc)
     print(f"FedAMW final: loss={loss:.5f} acc={acc:.5f}")
+    if metrics_out is not None:
+        # for in-process callers (sweep.py): regression trials must be
+        # ranked by MSE — acc is 0.0 there (fedcore/evaluate.py), and
+        # the NNI-reported value below faithfully keeps the reference's
+        # acc-only report (/root/reference/tune.py:135)
+        metrics_out.update(acc=acc, loss=loss)
     if HAS_NNI:
         nni.report_final_result(acc)
     return acc
