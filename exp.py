@@ -40,6 +40,13 @@ def parse_args():
                     choices=["reference", "paper", "constant"])
     ap.add_argument("--sequential", action="store_true",
                     help="reference client-contamination compat mode")
+    ap.add_argument("--shard", type=int, default=0, metavar="N",
+                    help="shard the client axis over an N-device "
+                         "jax.sharding.Mesh (0 = single device; jax "
+                         "backend only). Clients are padded to a "
+                         "multiple of N with inert empty clients; "
+                         "sharded rounds are pinned equal to "
+                         "unsharded in tests/test_mesh.py")
     ap.add_argument("--verbose", action="store_true",
                     help="stream per-round test loss/acc during the "
                          "jitted round scans (reference tools.py:236)")
@@ -61,7 +68,20 @@ def parse_args():
                          "global params + mixture weights under DIR "
                          "(orbax when available; the reference persists "
                          "metrics only)")
-    return ap.parse_args()
+    args = ap.parse_args()
+    if args.shard:
+        if args.shard < 0:
+            ap.error(f"--shard must be >= 0, got {args.shard}")
+        if args.backend != "jax":
+            ap.error("--shard requires --backend jax (mesh sharding is "
+                     "the jax path; the torch backend is the parity "
+                     "oracle twin)")
+        if args.sequential:
+            ap.error("--shard is incompatible with --sequential: the "
+                     "reference's contamination chain threads one model "
+                     "through every client in order, which is serial by "
+                     "construction")
+    return args
 
 
 def main():
@@ -144,7 +164,19 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete):
         setup = backend.prepare_setup(
             ds, D=args.D, kernel_par=k_par, kernel_type=kernel_type,
             seed=args.seed + t, rng=rng,
+            # mesh-even padding: inert empty clients round every client
+            # axis up to a multiple of the mesh (parallel.shard_setup)
+            **({"client_multiple": args.shard} if args.shard else {}),
         )
+        if args.shard:
+            from fedamw_tpu.parallel import make_mesh, shard_setup
+
+            setup = shard_setup(setup, make_mesh(args.shard))
+            if t == 0:
+                import jax
+
+                print(f"client axis sharded over {args.shard} devices "
+                      f"({jax.default_backend()})")
         # On FULL partitions, pre-val-split (reference exp.py:66-76).
         hete[t] = heterogeneity_from_parts(setup.X, ds.parts)
         print(f"[repeat {t}] data heterogeneity: {hete[t]:.4f}")

@@ -71,19 +71,26 @@ def run_sweep(dataset, trials, rounds, seed, backend="jax", trial_seed=1):
 
 
 def write_report(results, dataset, rounds, seed, out, trial_seed=1):
+    from fedamw_tpu.config import get_parameter
+
+    # the trial loss is the task's own objective — label it honestly
+    # (CE for classification, MSE for regression)
+    loss_label = ("final MSE"
+                  if get_parameter(dataset).get("task_type") == "regression"
+                  else "final CE")
     lines = [
         "# TUNING — FedAMW hyperparameter sweep (standalone)",
         "",
         f"`sweep.py --dataset {dataset} --trials {len(results)} "
-        f"--round {rounds} --seed {seed} --trial_seed {trial_seed}` "
-        f"— random search over the",
+        f"--round {rounds} --seed {seed} --trial_seed {trial_seed} "
+        f"--out {out}` — random search over the",
         "reference TPE grid (`/root/reference/config.yml:12-17`; NNI is",
         "not installed here, so this is the zero-dependency twin of the",
         "`nnictl` flow — `tune.py` is the trial entry in both). 50",
         "clients, Dirichlet alpha=0.01, D=2000 RFF, the registry's",
         "remaining hyperparameters.",
         "",
-        "| rank | lr_p | lambda_reg | final acc | final MSE | trial wall (s) |",
+        f"| rank | lr_p | lambda_reg | final acc | {loss_label} | trial wall (s) |",
         "|---|---|---|---|---|---|",
     ]
     for i, r in enumerate(results):

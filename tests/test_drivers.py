@@ -109,3 +109,39 @@ def test_results_report_regression_mode():
     assert "final test acc" in md
     best_rows = [ln for ln in md.splitlines() if "**best**" in ln]
     assert len(best_rows) == 1 and best_rows[0].startswith("| CL ")
+
+
+def test_exp_driver_sharded_matches_unsharded(tmp_path):
+    """--shard N runs the driver's client axis over an N-device mesh
+    (the test env is an 8-device virtual CPU mesh) and must reproduce
+    the unsharded run: losses to float noise; accuracies may flip by
+    single test samples when 1e-5-level logit noise crosses a decision
+    boundary (digits test split here is 180 samples -> one flip is
+    0.56 acc points)."""
+    common = [os.path.join(REPO, "exp.py"), "--dataset", "digits",
+              "--D", "128", "--num_partitions", "12", "--round", "3",
+              "--local_epoch", "1"]
+    outs = {}
+    for name, extra in (("sharded", ["--shard", "8"]), ("plain", [])):
+        d = tmp_path / name
+        d.mkdir()
+        out = _run(common + ["--result_dir", str(d)] + extra, cwd=str(d))
+        assert out.returncode == 0, out.stderr[-2000:]
+        with open(d / "exp1_digits.pkl", "rb") as f:
+            outs[name] = pickle.load(f)
+        if name == "sharded":
+            assert "sharded over 8 devices" in out.stdout
+    for k in ("train_loss", "test_loss"):
+        np.testing.assert_allclose(outs["sharded"][k], outs["plain"][k],
+                                   atol=1e-3)
+    np.testing.assert_allclose(outs["sharded"]["test_acc"],
+                               outs["plain"]["test_acc"], atol=1.5)
+
+
+def test_exp_driver_shard_flag_validation():
+    out = _run([os.path.join(REPO, "exp.py"), "--dataset", "digits",
+                "--shard", "8", "--backend", "torch"], cwd=REPO)
+    assert out.returncode != 0 and "--shard requires" in out.stderr
+    out = _run([os.path.join(REPO, "exp.py"), "--dataset", "digits",
+                "--shard", "8", "--sequential"], cwd=REPO)
+    assert out.returncode != 0 and "incompatible" in out.stderr
