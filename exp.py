@@ -47,6 +47,19 @@ def parse_args():
                          "multiple of N with inert empty clients; "
                          "sharded rounds are pinned equal to "
                          "unsharded in tests/test_mesh.py")
+    ap.add_argument("--multihost", action="store_true",
+                    help="join a multi-host JAX runtime before running "
+                         "(jax.distributed.initialize; the DCN tier — "
+                         "parallel.initialize_multihost). Launch the "
+                         "SAME command on every host; --shard defaults "
+                         "to the global device count; results are "
+                         "written by process 0 only")
+    ap.add_argument("--coordinator", type=str, default=None,
+                    help="multihost coordinator address host:port "
+                         "(default: from the environment, as on Cloud "
+                         "TPU pods)")
+    ap.add_argument("--num_processes", type=int, default=None)
+    ap.add_argument("--process_id", type=int, default=None)
     ap.add_argument("--verbose", action="store_true",
                     help="stream per-round test loss/acc during the "
                          "jitted round scans (reference tools.py:236)")
@@ -81,6 +94,16 @@ def parse_args():
                      "reference's contamination chain threads one model "
                      "through every client in order, which is serial by "
                      "construction")
+    if args.multihost:
+        if args.backend != "jax":
+            ap.error("--multihost requires --backend jax")
+        if args.sequential:
+            # --shard defaults to the global device count under
+            # multihost, so the sharded+serial-chain combination the
+            # --shard guard above rejects would otherwise slip through
+            ap.error("--multihost is incompatible with --sequential "
+                     "(the contamination chain is serial by "
+                     "construction; it cannot shard over hosts)")
     return args
 
 
@@ -94,6 +117,22 @@ def main():
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     args = parse_args()
+    if args.multihost:
+        # must land before any other JAX API: after this, jax.devices()
+        # is GLOBAL and make_mesh() spans hosts — the same compiled
+        # program, with aggregation all-reduces riding ICI within a
+        # slice and DCN across (parallel/mesh.py docstring)
+        import jax
+
+        from fedamw_tpu.parallel import initialize_multihost
+
+        n_global = initialize_multihost(
+            args.coordinator, args.num_processes, args.process_id)
+        if args.shard == 0:
+            args.shard = n_global
+        print(f"multihost: process {jax.process_index()}/"
+              f"{jax.process_count()}, {n_global} global devices, "
+              f"--shard {args.shard}")
     from fedamw_tpu.config import get_parameter
     from fedamw_tpu.registry import get_backend
 
@@ -134,11 +173,24 @@ def main():
         "heterogeneity": hete,
         "name": names,
     }
+    if not _is_writer(args):
+        # SPMD: every host computed identical matrices; one writer
+        return
     os.makedirs(args.result_dir, exist_ok=True)
     out = os.path.join(args.result_dir, f"exp1_{args.dataset}.pkl")
     with open(out, "wb") as f:
         pickle.dump(data_, f)
     print(f"results -> {out}")
+
+
+def _is_writer(args) -> bool:
+    """Single-writer gate for multihost runs (process 0); always true
+    single-host."""
+    if not args.multihost:
+        return True
+    import jax
+
+    return jax.process_index() == 0
 
 
 def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete):
@@ -232,7 +284,11 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete):
             error_mat[row, :, t] = res["test_loss"]
             acc_mat[row, :, t] = res["test_acc"]
             print(f"{name}: final acc {res['test_acc'][-1]:.2f}")
-            if "params" in res:
+            if "params" in res and _is_writer(args):
+                # one writer (matches the result-pickle gate): global
+                # params/p are replicated, so process 0 has the full
+                # state, and uncoordinated same-path saves from every
+                # process would race on a shared filesystem
                 from fedamw_tpu.utils.checkpoint import save_checkpoint
 
                 extra = {k: res[k]

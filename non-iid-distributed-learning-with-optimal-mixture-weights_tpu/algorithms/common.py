@@ -77,12 +77,28 @@ class FedSetup:
 
     @property
     def all_train_idx(self) -> jax.Array:
-        """One flat index set of every valid train row (for Centralized)."""
+        """One flat index set of every valid train row (for Centralized).
+
+        Under multihost the client-sharded index/mask arrays span
+        non-addressable devices, so the host view is assembled with a
+        process_allgather — a collective, which is fine: every process
+        reaches this property at the same SPMD point (Centralized runs
+        on all hosts) and gets the identical full set.
+        """
+
+        def host(x):
+            if getattr(x, "is_fully_addressable", True):
+                return np.asarray(x)
+            from jax.experimental import multihost_utils
+
+            return np.asarray(
+                multihost_utils.process_allgather(x, tiled=True))
+
         idx_tup, mask_tup = self.round_arrays()
         chunks = []
         for idx_g, mask_g in zip(idx_tup, mask_tup):
-            flat = np.asarray(idx_g).reshape(-1)
-            keep = np.asarray(mask_g).reshape(-1) > 0
+            flat = host(idx_g).reshape(-1)
+            keep = host(mask_g).reshape(-1) > 0
             chunks.append(flat[keep])
         return jnp.asarray(np.concatenate(chunks), dtype=jnp.int32)
 

@@ -190,3 +190,49 @@ def test_two_process_full_training_matches_single_process(tmp_path):
                                float(res["test_acc"][-1]), atol=1e-4)
     np.testing.assert_allclose(float(got["fedamw"]),
                                float(res2["test_acc"][-1]), atol=1e-4)
+
+
+def test_two_process_exp_driver(tmp_path):
+    """The experiment driver end to end across two processes
+    (--multihost): both hosts run the SAME command, the client axis
+    shards over the 2x2 global mesh, and exactly process 0 writes the
+    result pickle in the reference schema."""
+    addr = f"127.0.0.1:{_free_port()}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outdirs = [tmp_path / f"p{pid}" for pid in range(2)]
+    procs = []
+    for pid in range(2):
+        outdirs[pid].mkdir()
+        env = dict(os.environ)
+        env.update(JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=2",
+                   PYTHONPATH=repo)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(repo, "exp.py"),
+             "--dataset", "digits", "--D", "64", "--num_partitions", "6",
+             "--round", "2", "--local_epoch", "1", "--multihost",
+             "--coordinator", addr, "--num_processes", "2",
+             "--process_id", str(pid),
+             "--result_dir", str(outdirs[pid])],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=str(outdirs[pid]),
+        ))
+    outs = []
+    try:
+        for pr in procs:
+            out, _ = pr.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for pr in procs:
+            pr.kill()
+    for pid, (pr, out) in enumerate(zip(procs, outs)):
+        assert pr.returncode == 0, f"process {pid} failed:\n{out[-3000:]}"
+        assert "multihost: process" in out and "4 global devices" in out
+    # one writer: process 0's pickle exists in the reference schema,
+    # process 1 wrote nothing
+    import pickle as _pickle
+    with open(outdirs[0] / "exp1_digits.pkl", "rb") as f:
+        data = _pickle.load(f)
+    assert data["test_acc"].shape == (6, 2, 1)
+    assert np.all(np.isfinite(data["train_loss"]))
+    assert not (outdirs[1] / "exp1_digits.pkl").exists()
