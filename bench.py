@@ -45,11 +45,14 @@ line). Headline lines carry flops_per_update/achieved_gflops
 against both Pallas layout pairs (row/reshape defaults, then the
 pallas_col/pallas_nt lowering hedges, mixed pairs on failure) and
 labels the winner in "impl". BENCH_SWEEP_BUCKETS="8,16,32,64" appends
-a bucket-count sweep line; BENCH_SWEEP_ONLY=1 emits only it.
+a bucket-count sweep line and BENCH_SWEEP_UNROLL="1,4,8,16" a
+scan-unroll sweep line; BENCH_SWEEP_ONLY=1 emits only the gated sweep
+lines (tpu_window.sh step 4/5).
 
 Env overrides: BENCH_CLIENTS (default 256), BENCH_ROUNDS (default 20),
 BENCH_D (default 2000), BENCH_TORCH_ROUNDS (default 2), BENCH_BUCKETS
-(default 32), BENCH_AMW_TORCH_ROUNDS (default 2), BENCH_REF_ROUNDS /
+(default 32), FEDAMW_SCAN_UNROLL (client scan unroll, default 8),
+BENCH_AMW_TORCH_ROUNDS (default 2), BENCH_REF_ROUNDS /
 BENCH_AMW_REF_ROUNDS (default 2), BENCH_NO_REFERENCE (skip the
 reference arm), BENCH_NO_PALLAS, BENCH_FALLBACK_AMW=1/0,
 BENCH_CPU_FALLBACK_FULL=1, BENCH_PROFILE
@@ -203,6 +206,35 @@ def bench_jax_best(ds, D, rounds, algorithm="FedAvg", **kw):
     return best
 
 
+def _env_sweep(gate_var, target_var, label, ds, D, rounds):
+    """Shared machinery of the window-harvest sweeps: read the
+    comma-separated settings from ``gate_var``, time ``bench_jax`` once
+    per setting with ``target_var`` set to it, and restore the caller's
+    env. Returns {setting: updates/s} or None when ungated."""
+    settings = os.environ.get(gate_var)
+    if not settings:
+        return None
+    saved = os.environ.get(target_var)
+    out = {}
+    try:
+        for v in settings.split(","):
+            v = v.strip()
+            if not v:
+                continue
+            os.environ[target_var] = v
+            ups, acc, dt = bench_jax(ds, D, rounds)
+            out[v] = round(ups, 1)
+            print(f"# {label} sweep: {v:>3} -> {ups:9.1f} "
+                  f"updates/s ({rounds} rounds in {dt:.2f}s, acc "
+                  f"{acc:.2f})", file=sys.stderr)
+    finally:
+        if saved is None:
+            os.environ.pop(target_var, None)
+        else:
+            os.environ[target_var] = saved
+    return out
+
+
 def bucket_sweep(ds, D, rounds):
     """Env-gated (BENCH_SWEEP_BUCKETS="8,16,32,64") sweep of the
     size-bucket count. The workload is op-overhead-bound (PERFORMANCE.md
@@ -211,26 +243,19 @@ def bucket_sweep(ds, D, rounds):
     the cost of padding — where the optimum sits is a hardware
     question, which is why this ships as a window-harvest step rather
     than a fixed default. Returns {bucket_count: updates/s} or None."""
-    counts = os.environ.get("BENCH_SWEEP_BUCKETS")
-    if not counts:
-        return None
-    saved = os.environ.get("BENCH_BUCKETS")
-    out = {}
-    try:
-        for b in counts.split(","):
-            b = b.strip()
-            os.environ["BENCH_BUCKETS"] = b
-            ups, acc, dt = bench_jax(ds, D, rounds)
-            out[b] = round(ups, 1)
-            print(f"# bucket sweep: {b:>3} buckets -> {ups:9.1f} "
-                  f"updates/s ({rounds} rounds in {dt:.2f}s, acc "
-                  f"{acc:.2f})", file=sys.stderr)
-    finally:
-        if saved is None:
-            os.environ.pop("BENCH_BUCKETS", None)
-        else:
-            os.environ["BENCH_BUCKETS"] = saved
-    return out
+    return _env_sweep("BENCH_SWEEP_BUCKETS", "BENCH_BUCKETS", "bucket",
+                      ds, D, rounds)
+
+
+def unroll_sweep(ds, D, rounds):
+    """Env-gated (BENCH_SWEEP_UNROLL="1,4,8,16") sweep of the client
+    SGD scan-unroll factor. The per-step compute is microscopic, so the
+    default unroll=8 amortizes loop-trip overhead (fedcore/client.py);
+    how far unrolling pays before program size hurts is a hardware
+    question — a window-harvest step, like the bucket sweep. Returns
+    {unroll: updates/s} or None."""
+    return _env_sweep("BENCH_SWEEP_UNROLL", "FEDAMW_SCAN_UNROLL",
+                      "unroll", ds, D, rounds)
 
 
 def bench_reference(ds, D, rounds, algorithm="FedAvg", epoch=EPOCHS,
@@ -396,9 +421,9 @@ def main():
     platform = jax.default_backend()
 
     if os.environ.get("BENCH_SWEEP_ONLY"):
-        # sweep-only run (tpu_window.sh step 4/4): skip the headline /
+        # sweep-only run (tpu_window.sh step 4/5): skip the headline /
         # torch / reference / FedAMW legs — the window's earlier steps
-        # already harvested them — and emit just the sweep line
+        # already harvested them — and emit just the gated sweep lines
         _emit_bucket_sweep(ds, D, rounds, platform)
         return
 
@@ -515,9 +540,10 @@ def main():
                   f"took {jax_dt:.1f}s — cold cache; headline first); "
                   "set BENCH_FALLBACK_AMW=1 or BENCH_CPU_FALLBACK_FULL=1 "
                   "to keep it", file=sys.stderr)
-        if os.environ.get("BENCH_SWEEP_BUCKETS"):
-            print("# bucket sweep skipped in CPU fallback (headline "
-                  "first); use BENCH_SWEEP_ONLY=1 for a sweep-only run",
+        if (os.environ.get("BENCH_SWEEP_BUCKETS")
+                or os.environ.get("BENCH_SWEEP_UNROLL")):
+            print("# sweeps skipped in CPU fallback (headline first); "
+                  "use BENCH_SWEEP_ONLY=1 for a sweep-only run",
                   file=sys.stderr)
         print(json.dumps(headline))
         return
@@ -566,14 +592,14 @@ def main():
 
 
 def _emit_bucket_sweep(ds, D, rounds, platform):
-    """Run the env-gated sweep and print its JSON line; never raise —
-    a sweep-leg failure (compile/OOM at an untried bucket count) must
-    not cost the headline line that prints after it."""
+    """Run the env-gated sweeps and print their JSON lines; never raise
+    — a sweep-leg failure (compile/OOM at an untried setting) must not
+    cost the headline line that prints after it."""
     try:
         sweep = bucket_sweep(ds, D, rounds)
     except Exception as e:  # pragma: no cover - platform-dependent
         print(f"# bucket sweep failed: {e!r}", file=sys.stderr)
-        return
+        sweep = None
     if sweep:
         print(json.dumps({
             "metric": "bucket_sweep_updates_per_sec",
@@ -581,6 +607,24 @@ def _emit_bucket_sweep(ds, D, rounds, platform):
             "unit": "client-updates/s",
             "buckets": sweep,
             "default_buckets": os.environ.get("BENCH_BUCKETS", "32"),
+            "platform": platform,
+        }))
+    try:
+        usweep = unroll_sweep(ds, D, rounds)
+    except Exception as e:  # pragma: no cover - platform-dependent
+        print(f"# unroll sweep failed: {e!r}", file=sys.stderr)
+        usweep = None
+    if usweep:
+        from fedamw_tpu.fedcore.client import scan_unroll
+
+        print(json.dumps({
+            "metric": "unroll_sweep_updates_per_sec",
+            "value": max(usweep.values()),
+            "unit": "client-updates/s",
+            "unrolls": usweep,
+            # the EFFECTIVE default this run's non-sweep legs used
+            # (an ambient FEDAMW_SCAN_UNROLL overrides the constant)
+            "default_unroll": scan_unroll(),
             "platform": platform,
         }))
 

@@ -48,6 +48,29 @@ EPOCH_GATHER_BYTES_LIMIT = int(1.5e9)
 SGD_SCAN_UNROLL = 8
 
 
+def scan_unroll() -> int:
+    """The client-SGD scan unroll factor, env-tunable for the window
+    harvest's hardware sweep (BENCH_SWEEP_UNROLL -> FEDAMW_SCAN_UNROLL).
+    Read at trace time; algorithms include it in their trainer cache
+    key (algorithms.core._kernel_env) so a program compiled under one
+    setting is never reused under another."""
+    import os
+
+    v = os.environ.get("FEDAMW_SCAN_UNROLL", "").strip()
+    if not v:
+        return SGD_SCAN_UNROLL
+    try:
+        u = int(v)
+    except ValueError:
+        raise ValueError(
+            f"FEDAMW_SCAN_UNROLL={v!r}; expected a positive integer"
+        ) from None
+    if u < 1:
+        raise ValueError(
+            f"FEDAMW_SCAN_UNROLL={u}; expected a positive integer")
+    return u
+
+
 def epoch_gather_bytes(
     J: int, n_max: int, batch_size: int, D: int, itemsize: int
 ) -> int:
@@ -229,7 +252,7 @@ def make_local_update(
                     return sgd_step(p, X[rows_b], y[rows_b], bv)
 
             p, (losses, corrects, cnts) = jax.lax.scan(
-                step, p, xs, unroll=min(SGD_SCAN_UNROLL, num_batches)
+                step, p, xs, unroll=min(scan_unroll(), num_batches)
             )
             return p, weighted_epoch_metrics(losses, corrects, cnts)
 

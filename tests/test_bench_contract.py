@@ -86,13 +86,14 @@ def test_bench_cpu_fallback_contract():
 
 
 def test_bench_sweep_only_contract():
-    """BENCH_SWEEP_ONLY (tpu_window.sh step 4/4) must emit exactly one
-    JSON line — the bucket sweep — and skip every other leg, so the
-    window's sweep step never re-times what earlier steps harvested."""
+    """BENCH_SWEEP_ONLY (tpu_window.sh step 4/5) must emit exactly the
+    env-gated sweep JSON lines — bucket and unroll — and skip every
+    other leg, so the window's sweep step never re-times what earlier
+    steps harvested."""
     env = dict(os.environ)
     env.update(
         JAX_PLATFORMS="cpu", BENCH_NO_PROBE="1", BENCH_SWEEP_ONLY="1",
-        BENCH_SWEEP_BUCKETS="4,8",
+        BENCH_SWEEP_BUCKETS="4,8", BENCH_SWEEP_UNROLL="1,8",
         BENCH_CLIENTS="8", BENCH_D="64", BENCH_ROUNDS="2",
     )
     out = subprocess.run(
@@ -101,12 +102,16 @@ def test_bench_sweep_only_contract():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [json.loads(ln) for ln in out.stdout.splitlines() if ln]
-    assert len(lines) == 1
-    rec = lines[0]
+    assert len(lines) == 2
+    rec, urec = lines
     assert rec["metric"] == "bucket_sweep_updates_per_sec"
     assert set(rec["buckets"]) == {"4", "8"}
     assert rec["value"] == max(rec["buckets"].values())
     assert rec["platform"] == "cpu"
+    assert urec["metric"] == "unroll_sweep_updates_per_sec"
+    assert set(urec["unrolls"]) == {"1", "8"}
+    assert urec["value"] == max(urec["unrolls"].values())
+    assert urec["default_unroll"] == 8
     # no other legs ran (their stderr banners are absent)
     assert "torch-cpu" not in out.stderr
     assert "reference-loop" not in out.stderr

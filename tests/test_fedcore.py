@@ -273,3 +273,18 @@ def test_evaluator_matches_torch(small_problem):
         (out.argmax(1) == torch.tensor(np.array(y))).float().mean()
     )
     assert float(acc) == pytest.approx(want_acc, abs=1e-4)
+
+
+def test_scan_unroll_env_override(monkeypatch):
+    """FEDAMW_SCAN_UNROLL tunes the client-SGD scan unroll (the window
+    harvest's hardware sweep) and is part of the trainer cache key so a
+    program compiled under one setting is never reused under another."""
+    from fedamw_tpu.algorithms.core import _kernel_env
+    from fedamw_tpu.fedcore.client import SGD_SCAN_UNROLL, scan_unroll
+
+    monkeypatch.delenv("FEDAMW_SCAN_UNROLL", raising=False)
+    assert scan_unroll() == SGD_SCAN_UNROLL
+    base_key = _kernel_env()
+    monkeypatch.setenv("FEDAMW_SCAN_UNROLL", "4")
+    assert scan_unroll() == 4
+    assert _kernel_env() != base_key

@@ -93,10 +93,11 @@ if skip bucket_sweep; then echo "[$(stamp)] 4/5 sweep: already green, skipping";
 echo "[$(stamp)] 4/5 bucket sweep (op-overhead-bound workload: where is"
 echo "          the padding-vs-dispatch optimum on real hardware?)"
 # BENCH_SWEEP_ONLY skips the headline/torch/reference/FedAMW legs the
-# earlier steps already harvested — the 1200 s cap covers only the 4
-# sweep compiles+runs
+# earlier steps already harvested — the 2400 s cap covers the 8 sweep
+# legs (4 bucket counts + 4 unroll factors, each a compile + warm run)
 BENCH_STRICT_TPU=1 BENCH_SWEEP_ONLY=1 BENCH_SWEEP_BUCKETS="8,16,32,64" \
-  timeout 1200 python bench.py \
+  BENCH_SWEEP_UNROLL="1,4,8,16" \
+  timeout 2400 python bench.py \
   >"$OUT/bucket_sweep.json" 2>"$OUT/bucket_sweep.log"
 rc=$?; echo "rc=$rc sweep"; [ $rc -eq 0 ] && touch "$OUT/bucket_sweep.ok"
 grep bucket_sweep "$OUT/bucket_sweep.json" 2>/dev/null
