@@ -17,6 +17,7 @@ dataset names fall back to shape-matched synthetic.
 import argparse
 import os
 import pickle
+import sys
 import time
 
 import numpy as np
@@ -81,6 +82,15 @@ def parse_args():
                          "global params + mixture weights under DIR "
                          "(orbax when available; the reference persists "
                          "metrics only)")
+    ap.add_argument("--resume", action="store_true",
+                    help="preemption durability: a partial result file "
+                         "(exp1_{dataset}.partial.pkl, written after "
+                         "every completed repeat and kept after "
+                         "success) is loaded and the finished repeats "
+                         "are skipped — covering both crash-resume and "
+                         "extending --n_repeats later. The partial "
+                         "carries the run configuration and a mismatch "
+                         "is an error, not a silent mix")
     args = ap.parse_args()
     if args.shard:
         if args.shard < 0:
@@ -145,6 +155,62 @@ def main():
     acc_mat = np.empty((6, R, args.n_repeats))
     hete = np.empty(args.n_repeats)
 
+    partial_path = os.path.join(args.result_dir,
+                                f"exp1_{args.dataset}.partial.pkl")
+    if (not args.resume and os.path.exists(partial_path)
+            and _is_writer(args)):
+        # a fresh run must not clobber durable progress a preempted run
+        # left behind (its first completed repeat would overwrite a
+        # partial holding many): set it aside, recoverable
+        bak = partial_path + ".bak"
+        os.replace(partial_path, bak)
+        print(f"warning: {partial_path} exists from an earlier "
+              "(interrupted?) run but --resume was not given; moved it "
+              f"to {bak} so this fresh run cannot clobber that "
+              "progress", file=sys.stderr)
+    start_repeat = 0
+    bad_config = False
+    if args.resume and os.path.exists(partial_path) and _is_writer(args):
+        with open(partial_path, "rb") as f:
+            part = pickle.load(f)
+        if part["config"] != _resume_config(args):
+            bad_config = True
+            print(f"--resume: {partial_path} was written under a "
+                  f"different configuration\n  saved: {part['config']}\n"
+                  f"  now:   {_resume_config(args)}\nRemove the partial "
+                  "file to start over.", file=sys.stderr)
+        else:
+            k = min(int(part["done"]), args.n_repeats)
+            train_mat[:, :, :k] = part["train_loss"][:, :, :k]
+            error_mat[:, :, :k] = part["test_loss"][:, :, :k]
+            acc_mat[:, :, :k] = part["test_acc"][:, :, :k]
+            hete[:k] = part["heterogeneity"][:k]
+            start_repeat = k
+            print(f"--resume: {k} completed repeat(s) loaded from "
+                  f"{partial_path}; continuing at repeat {k}")
+    elif args.resume and _is_writer(args):
+        print(f"--resume: no partial file at {partial_path}; "
+              "starting fresh")
+    if args.multihost:
+        # every process must enter the SAME repeats (the sharded
+        # algorithms issue collectives): process 0's view of the
+        # partial is authoritative — hosts without a shared filesystem
+        # (or racing its visibility) would otherwise desync, with
+        # process 1 issuing repeat-0 all-reduces process 0 never joins.
+        # A config mismatch likewise aborts every process together.
+        import numpy as _np
+        from jax.experimental import multihost_utils
+
+        state = multihost_utils.broadcast_one_to_all(
+            _np.array([start_repeat, int(bad_config)], _np.int32))
+        start_repeat, bad_config = int(state[0]), bool(state[1])
+    if bad_config:
+        raise SystemExit(2)
+    if args.resume and args.multihost and start_repeat:
+        # only process 0 loaded the finished repeats' metrics; that is
+        # fine — they are only consumed by the process-0 writer
+        print(f"--resume (multihost): starting at repeat {start_repeat}")
+
     if args.profile and args.backend != "jax":
         print("--profile captures a jax.profiler trace; ignored for "
               f"backend={args.backend}")
@@ -155,7 +221,8 @@ def main():
         jax.profiler.start_trace(args.profile)
     try:
         _run_repeats(args, params, backend, train_mat, error_mat, acc_mat,
-                     hete)
+                     hete, start_repeat=start_repeat,
+                     partial_path=partial_path)
     finally:
         # flush the trace even when a repeat raises - a profile of the
         # failing run is the one you want most
@@ -181,6 +248,10 @@ def main():
     with open(out, "wb") as f:
         pickle.dump(data_, f)
     print(f"results -> {out}")
+    # the partial is kept on purpose: it carries the config signature
+    # the reference-schema result pickle cannot, so a later
+    # `--resume --n_repeats M` (M > this run's count) extends the
+    # experiment without recomputing finished repeats
 
 
 def _is_writer(args) -> bool:
@@ -193,7 +264,20 @@ def _is_writer(args) -> bool:
     return jax.process_index() == 0
 
 
-def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete):
+def _resume_config(args) -> dict:
+    """The run configuration a partial result file is only valid under:
+    everything that shapes a repeat's trajectory (--shard is excluded —
+    sharded==unsharded is test-pinned, so resuming across a device-count
+    change is sound)."""
+    return {k: getattr(args, k) for k in (
+        "dataset", "backend", "D", "num_partitions", "local_epoch",
+        "round", "batch_size", "alpha_Dirk", "seed", "lr_mode",
+        "sequential", "participation", "server_opt", "server_lr",
+        "data_dir")}
+
+
+def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete,
+                 start_repeat=0, partial_path=None):
     from fedamw_tpu.data import load_dataset
     from fedamw_tpu.ops.rff import heterogeneity_from_parts
 
@@ -207,7 +291,7 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete):
     lam_os = params.get("lambda_reg_os", lam)
     R = args.round
 
-    for t in range(args.n_repeats):
+    for t in range(start_repeat, args.n_repeats):
         rng = np.random.RandomState(args.seed + t)
         ds = load_dataset(
             args.dataset, args.num_partitions, args.alpha_Dirk,
@@ -303,6 +387,23 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete):
                 print(f"{name}: checkpoint -> {where}")
         print(f"[repeat {t}] wall time {time.time() - t0:.1f}s "
               f"(backend={args.backend})")
+        if partial_path is not None and _is_writer(args):
+            # preemption durability: every completed repeat is
+            # recoverable via --resume (repeats are independent — each
+            # reseeds from seed+t — so skipping finished ones is exact)
+            os.makedirs(os.path.dirname(partial_path) or ".",
+                        exist_ok=True)
+            tmp = partial_path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump({
+                    "config": _resume_config(args),
+                    "done": t + 1,
+                    "train_loss": train_mat[:, :, :t + 1].copy(),
+                    "test_loss": error_mat[:, :, :t + 1].copy(),
+                    "test_acc": acc_mat[:, :, :t + 1].copy(),
+                    "heterogeneity": hete[:t + 1].copy(),
+                }, f)
+            os.replace(tmp, partial_path)
 
 
 if __name__ == "__main__":

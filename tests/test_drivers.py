@@ -145,3 +145,62 @@ def test_exp_driver_shard_flag_validation():
     out = _run([os.path.join(REPO, "exp.py"), "--dataset", "digits",
                 "--shard", "8", "--sequential"], cwd=REPO)
     assert out.returncode != 0 and "incompatible" in out.stderr
+
+
+def test_exp_driver_resume(tmp_path):
+    """--resume: repeat-level preemption durability. A 1-repeat run
+    leaves a config-signed partial; rerunning with --n_repeats 2
+    --resume skips the finished repeat and the final artifact is
+    bit-exact vs an uninterrupted 2-repeat run (repeats are
+    independent — each reseeds from seed+t). A config mismatch is an
+    error, not a silent mix."""
+    common = [os.path.join(REPO, "exp.py"), "--dataset", "digits",
+              "--D", "96", "--num_partitions", "6", "--round", "2",
+              "--local_epoch", "1"]
+    d1, d2 = tmp_path / "a", tmp_path / "b"
+    d1.mkdir(), d2.mkdir()
+    out = _run(common + ["--n_repeats", "1", "--result_dir", str(d1)],
+               cwd=str(d1))
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert (d1 / "exp1_digits.partial.pkl").exists()
+    out = _run(common + ["--n_repeats", "2", "--resume",
+                         "--result_dir", str(d1)], cwd=str(d1))
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "continuing at repeat 1" in out.stdout
+    assert "[repeat 0]" not in out.stdout  # finished repeat skipped
+    out = _run(common + ["--n_repeats", "2", "--result_dir", str(d2)],
+               cwd=str(d2))
+    assert out.returncode == 0, out.stderr[-2000:]
+    with open(d1 / "exp1_digits.pkl", "rb") as f:
+        resumed = pickle.load(f)
+    with open(d2 / "exp1_digits.pkl", "rb") as f:
+        straight = pickle.load(f)
+    for k in ("train_loss", "test_loss", "test_acc", "heterogeneity"):
+        np.testing.assert_array_equal(resumed[k], straight[k])
+    # config mismatch refuses to mix
+    out = _run(common[:3] + ["--D", "64"] + common[5:]
+               + ["--n_repeats", "2", "--resume", "--result_dir", str(d1)],
+               cwd=str(d1))
+    assert out.returncode != 0
+    assert "different configuration" in out.stderr
+
+
+def test_exp_driver_fresh_run_backs_up_partial(tmp_path):
+    """A run WITHOUT --resume must not clobber an existing partial (the
+    durable progress of a preempted run): it is set aside as .bak with
+    a warning."""
+    common = [os.path.join(REPO, "exp.py"), "--dataset", "digits",
+              "--D", "96", "--num_partitions", "6", "--round", "2",
+              "--local_epoch", "1", "--n_repeats", "1",
+              "--result_dir", str(tmp_path)]
+    out = _run(common, cwd=str(tmp_path))
+    assert out.returncode == 0, out.stderr[-2000:]
+    partial = tmp_path / "exp1_digits.partial.pkl"
+    assert partial.exists()
+    with open(partial, "rb") as f:
+        saved = f.read()
+    out = _run(common, cwd=str(tmp_path))
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "cannot clobber" in out.stderr
+    with open(tmp_path / "exp1_digits.partial.pkl.bak", "rb") as f:
+        assert f.read() == saved
