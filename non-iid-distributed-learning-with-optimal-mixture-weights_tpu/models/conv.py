@@ -1,0 +1,83 @@
+"""Small convolutional models for the image datasets.
+
+Beyond the reference's surface (its "MLP" is a single linear layer,
+``functions/tools.py:34-40``, fed flattened pixels): a compact CNN puts
+real MXU work in each client update — ``lax.conv_general_dilated`` on
+TPU tiles directly onto the systolic array, lifting the per-update
+arithmetic intensity far above the linear model's 3 FLOP/byte
+(PERFORMANCE.md § MFU). Everything downstream is unchanged: the model
+is a plain pytree with an init/apply pair, the client kernel autodiffs
+it, and aggregation / checkpointing / the FedAMW logit stack are
+pytree-generic, so it federates exactly like the flagship.
+
+The data layer keeps features flattened ``(N, d)`` (reference
+``data_tf``, ``utils.py:67-72``); ``apply`` folds them back to the
+square ``(H, W, 1)`` image NHWC expects, so the CNN drops into any
+``prepare_setup`` whose feature dimension is a perfect square with
+``kernel_type="linear"`` (identity feature map — RFF features are not
+images).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .linear import Model, xavier_uniform
+
+
+def conv_model(channels=(8, 16), kernel: int = 3) -> Model:
+    """``channels`` conv layers (ReLU, stride-2 downsampling) and a
+    biasless linear head — the zoo's smallest genuinely convolutional
+    member. Input: flattened square grayscale images ``(B, H*W)``."""
+    chans = (channels,) if isinstance(channels, int) else tuple(channels)
+    if not chans or any(c <= 0 for c in chans):
+        raise ValueError(f"channel counts must be positive, got {chans}")
+
+    def init(key, d, num_classes):
+        side = math.isqrt(d)
+        if side * side != d:
+            raise ValueError(
+                f"conv models need flattened square images; feature "
+                f"dimension {d} is not a perfect square. (RFF-mapped "
+                "features are not images — use kernel_type='linear'.)")
+        keys = jax.random.split(key, len(chans) + 1)
+        params = {}
+        fan_in = 1
+        for i, (k, c) in enumerate(zip(keys, chans), start=1):
+            # HWIO layout; xavier on the fan pair, fanned by the window
+            rf = kernel * kernel
+            bound = math.sqrt(6.0 / (rf * fan_in + rf * c))
+            params[f"k{i}"] = jax.random.uniform(
+                k, (kernel, kernel, fan_in, c), jnp.float32,
+                minval=-bound, maxval=bound)
+            params[f"cb{i}"] = jnp.zeros((c,), jnp.float32)
+            fan_in = c
+        # head fan-in: each stride-2 conv halves H and W (ceil)
+        h = side
+        for _ in chans:
+            h = -(-h // 2)
+        params["w"] = xavier_uniform(keys[-1], (num_classes,
+                                                h * h * chans[-1]))
+        return params
+
+    def apply(params, x):
+        b, d = x.shape
+        side = math.isqrt(d)
+        # bf16 feature path: conv_general_dilated requires matching
+        # dtypes (matmuls promote, convs don't) — compute stays f32,
+        # the same contract the matmul models get for free
+        h = x.astype(params["k1"].dtype).reshape(b, side, side, 1)
+        for i in range(1, len(chans) + 1):
+            h = jax.lax.conv_general_dilated(
+                h, params[f"k{i}"], window_strides=(2, 2),
+                padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            ) + params[f"cb{i}"]
+            h = jax.nn.relu(h)
+        return h.reshape(b, -1) @ params["w"].T
+
+    return Model(name="conv" + "x".join(str(c) for c in chans),
+                 init=init, apply=apply)

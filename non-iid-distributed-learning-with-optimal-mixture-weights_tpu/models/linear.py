@@ -88,8 +88,9 @@ def mlp_model(hidden=64) -> Model:
 
 
 def get_model(name: str, **kwargs) -> Model:
-    """``"linear"``, ``"mlp"`` (default width 64), ``"mlp128"``, or a
-    deeper ``"mlp128x64"`` (x-separated hidden widths)."""
+    """``"linear"``, ``"mlp"`` (default width 64), ``"mlp128"`` /
+    ``"mlp128x64"`` (x-separated hidden widths), or ``"conv"`` /
+    ``"conv8x16"`` (x-separated conv channels; see ``models/conv.py``)."""
     if name == "linear":
         return linear_model()
     if name.startswith("mlp"):
@@ -100,4 +101,12 @@ def get_model(name: str, **kwargs) -> Model:
         else:
             hidden = kwargs.pop("hidden", 64)
         return mlp_model(hidden)
+    if name.startswith("conv"):
+        from .conv import conv_model
+
+        spec = name[4:]
+        kw_channels = kwargs.pop("channels", (8, 16))
+        channels = (tuple(int(c) for c in spec.split("x")) if spec
+                    else kw_channels)
+        return conv_model(channels, **kwargs)
     raise ValueError(f"unknown model: {name}")
