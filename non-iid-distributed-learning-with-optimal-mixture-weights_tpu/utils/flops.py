@@ -4,12 +4,19 @@ One definition so the two harnesses cannot drift (r4 advisor): the
 client local-SGD cost of one *client-update* (= one client's full local
 training for one communication round) is
 
-    3 · fwd_flops_per_sample(params) · epochs · n_mean
+    3 · fwd_flops_per_sample(...) · epochs · n_mean
 
-with fwd counted from the model's actual weight matrices (2·in·out per
-GEMM) and bwd ≈ 2× fwd (`x^T g` for the weight grad plus the input-side
-grad). This counts the client GEMMs ONLY — FedAMW's p-solver and logit
-cache are excluded (callers must label such records; see
+with bwd ≈ 2× fwd (`x^T g` for the weight grad plus the input-side
+grad). The forward count has two regimes: GEMM-only models (every
+weight leaf 2-D — the linear flagship and the MLPs, i.e. everything
+bench.py times) use the weight-shape formula 2·in·out per GEMM, which
+every committed artifact used; models with higher-rank weight leaves
+(conv kernels) use XLA's cost model on the lowered forward, because
+parameter shapes cannot express a conv's output-size-proportional work
+— so the two harnesses agree wherever they measure the same model, and
+conv configs (scale_bench only) get an honest count the formula cannot
+give. This counts the client forward/backward ONLY — FedAMW's p-solver
+and logit cache are excluded (callers must label such records; see
 PERFORMANCE.md § MFU/roofline for the derivation and the measured
 utilization tables).
 """
@@ -19,14 +26,54 @@ from __future__ import annotations
 import numpy as np
 
 
-def fwd_flops_per_sample(params) -> int:
-    """Forward FLOPs for one sample: 2·(in·out) summed over the
-    model's 2-D weight leaves (bias adds are negligible and skipped)."""
+def fwd_flops_per_sample(params, apply_fn=None, d=None) -> int:
+    """Forward FLOPs for one sample.
+
+    GEMM-only models (every weight leaf 2-D): 2·(in·out) summed over
+    the weight matrices (bias adds are negligible and skipped) — the
+    documented formula every committed artifact used.
+
+    Models with higher-rank weight leaves (conv kernels, 4-D HWIO):
+    parameter shapes alone cannot give the cost — a conv does work
+    proportional to its OUTPUT spatial size, reusing each kernel weight
+    across positions — so when ``apply_fn``/``d`` are provided the
+    count comes from XLA's own cost model on the lowered single-sample
+    forward (exact for any model, including elementwise ops).
+    """
     import jax
 
+    leaves = jax.tree.leaves(params)
+    if apply_fn is not None and d is not None and any(
+        np.ndim(w) > 2 for w in leaves
+    ):
+        import jax.numpy as jnp
+
+        cost = (
+            jax.jit(apply_fn)
+            .lower(params, jnp.zeros((1, d), jnp.float32))
+            .compile()
+            .cost_analysis()
+        )
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        flops = (cost or {}).get("flops", 0.0)
+        if flops:
+            return int(flops)
+        # the GEMM formula below is WRONG for >2-D leaves (it would
+        # count only the linear head, a ~10x undercount for convs) —
+        # never degrade silently on a runtime whose cost_analysis is
+        # absent (plausible on experimental PJRT plugins)
+        import warnings
+
+        warnings.warn(
+            "fwd_flops_per_sample: XLA cost_analysis unavailable on "
+            "this runtime; falling back to the 2-D GEMM formula, which "
+            "UNDERCOUNTS models with conv kernels — treat the FLOPs "
+            "fields of this record as a lower bound",
+            RuntimeWarning, stacklevel=2)
     return sum(
         2 * int(np.prod(np.shape(w)))
-        for w in jax.tree.leaves(params)
+        for w in leaves
         if np.ndim(w) == 2
     )
 

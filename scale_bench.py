@@ -23,7 +23,9 @@ Prints one JSON line per config:
      "final_acc": ..., "hbm_peak_gb": ..., "wall_s": ...}
 
 Env: SCALE_ROUNDS (default 10), SCALE_BUCKETS (default 64),
-SCALE_CONFIGS (comma list, default "covtype1024,rcv14096").
+SCALE_CONFIGS (comma list, default
+"covtype1024,rcv14096,mnistconv512" — the third is an MNIST-shaped
+512-client run of the zoo's compact CNN, the MXU-heavy config).
 """
 
 import json
@@ -65,8 +67,10 @@ def run_config(name, ds, model, kernel_type, D, num_clients, rounds,
     params = setup.model.init(jax.random.PRNGKey(0), setup.D,
                               setup.num_classes)
     n_mean = float(np.mean(np.asarray(setup.sizes)))
-    flops_upd = client_update_flops(fwd_flops_per_sample(params), epoch,
-                                    n_mean)
+    flops_upd = client_update_flops(
+        fwd_flops_per_sample(params, apply_fn=setup.model.apply,
+                             d=setup.D),
+        epoch, n_mean)
     recs = []
     for alg in algorithms:
         fn = getattr(algs, alg)
@@ -132,6 +136,29 @@ def covtype_1024(rounds, buckets):
                       1024, rounds, buckets)
 
 
+def mnist_conv_512(rounds, buckets):
+    """MNIST signature (60k x 784 flattened 28x28 grayscale, 10-class),
+    the zoo's compact CNN (``conv8x16``), 512 Dirichlet(alpha=0.1)
+    clients. The conv config is the MXU-heavy member of the scale
+    table: each client update runs real convolutions instead of the
+    linear flagship's 3-FLOP/byte GEMMs, so this measures the framework
+    where arithmetic, not op overhead, should dominate."""
+    from fedamw_tpu.data import FederatedDataset, dirichlet_partition
+    from fedamw_tpu.data.synthetic import synthetic_classification
+
+    X, y, Xt, yt = synthetic_classification(60000, 784, 10, seed=13,
+                                            test_fraction=1 / 6)
+    parts, _ = dirichlet_partition(y, 512, alpha=0.1, seed=2020,
+                                   min_size=0)
+    ds = FederatedDataset(
+        name="mnist-synth", task_type="classification", num_classes=10,
+        d=784, X_train=X, y_train=y, X_test=Xt, y_test=yt, parts=parts,
+        source="synthetic",
+    )
+    return run_config("mnist_conv_512", ds, "conv8x16", "linear", 784,
+                      512, rounds, buckets)
+
+
 def rcv1_4096(rounds, buckets):
     """rcv1.binary signature: 20,242 train rows, d=47,236 sparse ->
     RFF D=2000, 4096 clients (most hold a handful of samples)."""
@@ -184,13 +211,16 @@ def main():
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     rounds = int(os.environ.get("SCALE_ROUNDS", "10"))
     buckets = int(os.environ.get("SCALE_BUCKETS", "64"))
-    configs = os.environ.get("SCALE_CONFIGS", "covtype1024,rcv14096")
+    configs = os.environ.get("SCALE_CONFIGS",
+                             "covtype1024,rcv14096,mnistconv512")
     for c in configs.split(","):
         t0 = time.perf_counter()
         if c.strip() == "covtype1024":
             covtype_1024(rounds, buckets)
         elif c.strip() == "rcv14096":
             rcv1_4096(rounds, buckets)
+        elif c.strip() == "mnistconv512":
+            mnist_conv_512(rounds, buckets)
         else:
             print(f"# unknown config {c}", file=sys.stderr)
         print(f"# {c}: total {time.perf_counter() - t0:.1f}s "

@@ -274,3 +274,29 @@ class TestConvModel:
                      seed=0, lr_mode="constant")
         acc = float(np.asarray(res["test_acc"])[-1])
         assert acc > 60.0, acc  # 10 classes, chance = 10%; measured 80
+
+
+def test_conv_flops_use_xla_cost_model():
+    """Conv kernels are 4-D and do work proportional to their output
+    spatial size — parameter shapes alone undercount them (only the
+    linear head would register). With apply_fn/d the count comes from
+    XLA's cost model; GEMM-only models keep the documented 2·in·out
+    formula bit-for-bit (committed artifact continuity)."""
+    from fedamw_tpu.models import get_model
+    from fedamw_tpu.utils.flops import fwd_flops_per_sample
+
+    m = get_model("conv8x16")
+    p = m.init(jax.random.PRNGKey(0), 784, 10)
+    head_only = fwd_flops_per_sample(p)
+    full = fwd_flops_per_sample(p, apply_fn=m.apply, d=784)
+    assert head_only == 2 * 784 * 10  # the (10, 7*7*16) head alone
+    # hand estimate (interior positions): conv1 2*9*1*8*14*14 = 28,224
+    # + conv2 2*9*8*16*7*7 = 112,896 + head 15,680 = 156,800; XLA's
+    # SAME-padding edge handling counts slightly fewer
+    assert 100_000 < full <= 160_000, full
+
+    lm = get_model("linear")
+    lp = lm.init(jax.random.PRNGKey(0), 2000, 2)
+    assert (fwd_flops_per_sample(lp)
+            == fwd_flops_per_sample(lp, apply_fn=lm.apply, d=2000)
+            == 2 * 2000 * 2)
