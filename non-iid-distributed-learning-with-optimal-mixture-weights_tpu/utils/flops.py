@@ -26,7 +26,8 @@ from __future__ import annotations
 import numpy as np
 
 
-def fwd_flops_per_sample(params, apply_fn=None, d=None) -> int:
+def fwd_flops_per_sample(params, apply_fn=None, d=None,
+                         with_provenance=False):
     """Forward FLOPs for one sample.
 
     GEMM-only models (every weight leaf 2-D): 2·(in·out) summed over
@@ -39,13 +40,20 @@ def fwd_flops_per_sample(params, apply_fn=None, d=None) -> int:
     across positions — so when ``apply_fn``/``d`` are provided the
     count comes from XLA's own cost model on the lowered single-sample
     forward (exact for any model, including elementwise ops).
+
+    ``with_provenance=True`` returns ``(flops, exact)`` instead of the
+    bare count: ``exact=False`` means the GEMM formula was applied to a
+    model it undercounts (conv leaves present but the runtime's
+    cost_analysis was unavailable) — callers must LABEL such records
+    (scale_bench attaches a ``flops_note``), not just rely on the
+    stderr warning, because the JSON artifact is what gets committed.
     """
     import jax
 
     leaves = jax.tree.leaves(params)
-    if apply_fn is not None and d is not None and any(
-        np.ndim(w) > 2 for w in leaves
-    ):
+    has_high_rank = any(np.ndim(w) > 2 for w in leaves)
+    exact = True
+    if apply_fn is not None and d is not None and has_high_rank:
         import jax.numpy as jnp
 
         cost = (
@@ -58,7 +66,7 @@ def fwd_flops_per_sample(params, apply_fn=None, d=None) -> int:
             cost = cost[0] if cost else {}
         flops = (cost or {}).get("flops", 0.0)
         if flops:
-            return int(flops)
+            return (int(flops), True) if with_provenance else int(flops)
         # the GEMM formula below is WRONG for >2-D leaves (it would
         # count only the linear head, a ~10x undercount for convs) —
         # never degrade silently on a runtime whose cost_analysis is
@@ -71,11 +79,16 @@ def fwd_flops_per_sample(params, apply_fn=None, d=None) -> int:
             "UNDERCOUNTS models with conv kernels — treat the FLOPs "
             "fields of this record as a lower bound",
             RuntimeWarning, stacklevel=2)
-    return sum(
+        exact = False
+    elif has_high_rank:
+        # no apply_fn/d to lower with: same undercount, same contract
+        exact = False
+    flops = sum(
         2 * int(np.prod(np.shape(w)))
         for w in leaves
         if np.ndim(w) == 2
     )
+    return (flops, exact) if with_provenance else flops
 
 
 def client_update_flops(fwd_per_sample: float, epochs: int,

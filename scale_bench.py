@@ -67,10 +67,10 @@ def run_config(name, ds, model, kernel_type, D, num_clients, rounds,
     params = setup.model.init(jax.random.PRNGKey(0), setup.D,
                               setup.num_classes)
     n_mean = float(np.mean(np.asarray(setup.sizes)))
-    flops_upd = client_update_flops(
-        fwd_flops_per_sample(params, apply_fn=setup.model.apply,
-                             d=setup.D),
-        epoch, n_mean)
+    fwd, fwd_exact = fwd_flops_per_sample(
+        params, apply_fn=setup.model.apply, d=setup.D,
+        with_provenance=True)
+    flops_upd = client_update_flops(fwd, epoch, n_mean)
     recs = []
     for alg in algorithms:
         fn = getattr(algs, alg)
@@ -101,6 +101,14 @@ def run_config(name, ds, model, kernel_type, D, num_clients, rounds,
             # is higher than this field — label rather than mislabel
             rec["flops_note"] = ("client local-SGD GEMMs only; excludes "
                                  "p-solver/logit work")
+        if not fwd_exact:
+            # conv leaves counted by the GEMM formula (runtime without
+            # cost_analysis): the artifact itself must say so — the
+            # stderr warning does not travel with the JSON
+            rec["flops_note"] = (rec.get("flops_note", "") +
+                                 "; LOWER BOUND: cost_analysis "
+                                 "unavailable, conv work uncounted"
+                                 ).lstrip("; ")
         if os.environ.get("SCALE_MEMORY", "1") != "0":
             # AOT compile report: the axon runtime has no live
             # memory_stats(), so the compiler's own buffer assignment is
