@@ -66,6 +66,15 @@ def parse_args():
                          "jitted round scans (reference tools.py:236)")
     ap.add_argument("--profile", type=str, default=None, metavar="DIR",
                     help="capture a jax.profiler trace of the run to DIR")
+    ap.add_argument("--model", type=str, default="linear",
+                    help="extension: any zoo member (linear | mlp64 | "
+                         "mlp128x64 | conv8x16 ...) — every model is a "
+                         "pytree, so all six algorithms run unchanged. "
+                         "Non-linear models force kernel_type='linear' "
+                         "(identity features: RFF-mapped features are "
+                         "not raw inputs; conv additionally needs "
+                         "square images). The reference surface is the "
+                         "default")
     ap.add_argument("--participation", type=float, default=1.0,
                     help="extension: per-round Bernoulli client sampling "
                          "for FedAvg/FedProx (FedAMW always runs full "
@@ -104,6 +113,9 @@ def parse_args():
                      "reference's contamination chain threads one model "
                      "through every client in order, which is serial by "
                      "construction")
+    if args.model != "linear" and args.backend != "jax":
+        ap.error("--model is a jax-backend extension (the torch twin "
+                 "implements the reference's linear model only)")
     if args.multihost:
         if args.backend != "jax":
             ap.error("--multihost requires --backend jax")
@@ -173,10 +185,18 @@ def main():
     if args.resume and os.path.exists(partial_path) and _is_writer(args):
         with open(partial_path, "rb") as f:
             part = pickle.load(f)
-        if part["config"] != _resume_config(args):
+        # partials written before a config key existed resume cleanly
+        # under that key's argparse default (a pre---model file IS a
+        # linear run) — a strict comparison would throw away their
+        # finished repeats over a key that could not have differed.
+        # Keys added to _resume_config after the format shipped, with
+        # the default they had when absent:
+        saved_cfg = {"model": "linear", "data_dir": "datasets",
+                     **part["config"]}
+        if saved_cfg != _resume_config(args):
             bad_config = True
             print(f"--resume: {partial_path} was written under a "
-                  f"different configuration\n  saved: {part['config']}\n"
+                  f"different configuration\n  saved: {saved_cfg}\n"
                   f"  now:   {_resume_config(args)}\nRemove the partial "
                   "file to start over.", file=sys.stderr)
         else:
@@ -273,7 +293,7 @@ def _resume_config(args) -> dict:
         "dataset", "backend", "D", "num_partitions", "local_epoch",
         "round", "batch_size", "alpha_Dirk", "seed", "lr_mode",
         "sequential", "participation", "server_opt", "server_lr",
-        "data_dir")}
+        "data_dir", "model")}
 
 
 def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete,
@@ -282,6 +302,14 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete,
     from fedamw_tpu.ops.rff import heterogeneity_from_parts
 
     kernel_type = params["kernel_type"]
+    if args.model != "linear":
+        # the zoo's deeper models consume raw features — the RFF map
+        # exists to linearize the kernel for the single-matrix model
+        if kernel_type != "linear":
+            print(f"--model {args.model}: forcing kernel_type='linear' "
+                  "(identity features; the registry's RFF map serves "
+                  "the linear flagship)")
+        kernel_type = "linear"
     k_par = params["kernel_par"]
     lr = params["lr"]
     lr_p = params.get("lr_p", 1e-3)
@@ -303,6 +331,7 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete,
             # mesh-even padding: inert empty clients round every client
             # axis up to a multiple of the mesh (parallel.shard_setup)
             **({"client_multiple": args.shard} if args.shard else {}),
+            **({"model": args.model} if args.model != "linear" else {}),
         )
         if args.shard:
             from fedamw_tpu.parallel import make_mesh, shard_setup

@@ -204,3 +204,20 @@ def test_exp_driver_fresh_run_backs_up_partial(tmp_path):
     assert "cannot clobber" in out.stderr
     with open(tmp_path / "exp1_digits.partial.pkl.bak", "rb") as f:
         assert f.read() == saved
+
+def test_exp_driver_model_extension(tmp_path):
+    """--model runs the reference experiment flow with any zoo member
+    (jax-only extension; the torch twin is the linear parity oracle)."""
+    out = _run([os.path.join(REPO, "exp.py"), "--dataset", "digits",
+                "--model", "mlp16", "--backend", "torch"], cwd=REPO)
+    assert out.returncode != 0 and "jax-backend extension" in out.stderr
+    out = _run([os.path.join(REPO, "exp.py"), "--dataset", "digits",
+                "--D", "64", "--num_partitions", "4", "--round", "2",
+                "--local_epoch", "1", "--model", "mlp16",
+                "--result_dir", str(tmp_path)], cwd=str(tmp_path))
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "forcing kernel_type='linear'" in out.stdout
+    with open(tmp_path / "exp1_digits.pkl", "rb") as f:
+        data = pickle.load(f)
+    assert data["test_acc"].shape == (6, 2, 1)
+    assert np.all(np.isfinite(data["train_loss"]))
