@@ -47,7 +47,7 @@ pallas_col/pallas_nt lowering hedges, mixed pairs on failure) and
 labels the winner in "impl". BENCH_SWEEP_BUCKETS="8,16,32,64" appends
 a bucket-count sweep line and BENCH_SWEEP_UNROLL="1,4,8,16" a
 scan-unroll sweep line; BENCH_SWEEP_ONLY=1 emits only the gated sweep
-lines (tpu_window.sh step 4/5).
+lines (tpu_window.sh step 5/5).
 
 Env overrides: BENCH_CLIENTS (default 256), BENCH_ROUNDS (default 20),
 BENCH_D (default 2000), BENCH_TORCH_ROUNDS (default 2), BENCH_BUCKETS
@@ -421,7 +421,7 @@ def main():
     platform = jax.default_backend()
 
     if os.environ.get("BENCH_SWEEP_ONLY"):
-        # sweep-only run (tpu_window.sh step 4/5): skip the headline /
+        # sweep-only run (tpu_window.sh step 5/5): skip the headline /
         # torch / reference / FedAMW legs — the window's earlier steps
         # already harvested them — and emit just the gated sweep lines
         _emit_bucket_sweep(ds, D, rounds, platform)

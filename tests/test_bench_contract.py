@@ -86,7 +86,7 @@ def test_bench_cpu_fallback_contract():
 
 
 def test_bench_sweep_only_contract():
-    """BENCH_SWEEP_ONLY (tpu_window.sh step 4/5) must emit exactly the
+    """BENCH_SWEEP_ONLY (tpu_window.sh step 5/5) must emit exactly the
     env-gated sweep JSON lines — bucket and unroll — and skip every
     other leg, so the window's sweep step never re-times what earlier
     steps harvested."""

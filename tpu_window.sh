@@ -89,23 +89,8 @@ tail -2 "$OUT/scale.json" 2>/dev/null
 fi
 
 echo "[$(stamp)] probe"; probe
-if skip bucket_sweep; then echo "[$(stamp)] 4/5 sweep: already green, skipping"; else
-echo "[$(stamp)] 4/5 bucket sweep (op-overhead-bound workload: where is"
-echo "          the padding-vs-dispatch optimum on real hardware?)"
-# BENCH_SWEEP_ONLY skips the headline/torch/reference/FedAMW legs the
-# earlier steps already harvested — the 2400 s cap covers the 8 sweep
-# legs (4 bucket counts + 4 unroll factors, each a compile + warm run)
-BENCH_STRICT_TPU=1 BENCH_SWEEP_ONLY=1 BENCH_SWEEP_BUCKETS="8,16,32,64" \
-  BENCH_SWEEP_UNROLL="1,4,8,16" \
-  timeout 2400 python bench.py \
-  >"$OUT/bucket_sweep.json" 2>"$OUT/bucket_sweep.log"
-rc=$?; echo "rc=$rc sweep"; [ $rc -eq 0 ] && touch "$OUT/bucket_sweep.ok"
-grep bucket_sweep "$OUT/bucket_sweep.json" 2>/dev/null
-fi
-
-echo "[$(stamp)] probe"; probe
-if skip exp_tpu; then echo "[$(stamp)] 5/5 exp.py: already green, skipping"; else
-echo "[$(stamp)] 5/5 exp.py full defaults on the chip (the reference's"
+if skip exp_tpu; then echo "[$(stamp)] 4/5 exp.py: already green, skipping"; else
+echo "[$(stamp)] 4/5 exp.py full defaults on the chip (the reference's"
 echo "          own experiment — J=50, alpha=0.01, D=2000, 100 rounds,"
 echo "          all 6 algorithms x 5 repeats — as a timed TPU artifact;"
 echo "          CPU takes ~120 s/repeat, RESULTS.md)"
@@ -117,6 +102,21 @@ if [ $rc -eq 0 ] && [ -f results/exp1_digits.pkl ]; then
   touch "$OUT/exp_tpu.ok"
 fi
 tail -4 "$OUT/exp_tpu.log"
+fi
+
+echo "[$(stamp)] probe"; probe
+if skip bucket_sweep; then echo "[$(stamp)] 5/5 sweep: already green, skipping"; else
+echo "[$(stamp)] 5/5 bucket sweep (op-overhead-bound workload: where is"
+echo "          the padding-vs-dispatch optimum on real hardware?)"
+# BENCH_SWEEP_ONLY skips the headline/torch/reference/FedAMW legs the
+# earlier steps already harvested — the 2400 s cap covers the 8 sweep
+# legs (4 bucket counts + 4 unroll factors, each a compile + warm run)
+BENCH_STRICT_TPU=1 BENCH_SWEEP_ONLY=1 BENCH_SWEEP_BUCKETS="8,16,32,64" \
+  BENCH_SWEEP_UNROLL="1,4,8,16" \
+  timeout 2400 python bench.py \
+  >"$OUT/bucket_sweep.json" 2>"$OUT/bucket_sweep.log"
+rc=$?; echo "rc=$rc sweep"; [ $rc -eq 0 ] && touch "$OUT/bucket_sweep.ok"
+grep bucket_sweep "$OUT/bucket_sweep.json" 2>/dev/null
 fi
 
 echo "[$(stamp)] done -> $OUT/"
