@@ -169,67 +169,8 @@ def main():
 
     partial_path = os.path.join(args.result_dir,
                                 f"exp1_{args.dataset}.partial.pkl")
-    if (not args.resume and os.path.exists(partial_path)
-            and _is_writer(args)):
-        # a fresh run must not clobber durable progress a preempted run
-        # left behind (its first completed repeat would overwrite a
-        # partial holding many): set it aside, recoverable
-        bak = partial_path + ".bak"
-        os.replace(partial_path, bak)
-        print(f"warning: {partial_path} exists from an earlier "
-              "(interrupted?) run but --resume was not given; moved it "
-              f"to {bak} so this fresh run cannot clobber that "
-              "progress", file=sys.stderr)
-    start_repeat = 0
-    bad_config = False
-    if args.resume and os.path.exists(partial_path) and _is_writer(args):
-        with open(partial_path, "rb") as f:
-            part = pickle.load(f)
-        # partials written before a config key existed resume cleanly
-        # under that key's argparse default (a pre---model file IS a
-        # linear run) — a strict comparison would throw away their
-        # finished repeats over a key that could not have differed.
-        # Keys added to _resume_config after the format shipped, with
-        # the default they had when absent:
-        saved_cfg = {"model": "linear", "data_dir": "datasets",
-                     **part["config"]}
-        if saved_cfg != _resume_config(args):
-            bad_config = True
-            print(f"--resume: {partial_path} was written under a "
-                  f"different configuration\n  saved: {saved_cfg}\n"
-                  f"  now:   {_resume_config(args)}\nRemove the partial "
-                  "file to start over.", file=sys.stderr)
-        else:
-            k = min(int(part["done"]), args.n_repeats)
-            train_mat[:, :, :k] = part["train_loss"][:, :, :k]
-            error_mat[:, :, :k] = part["test_loss"][:, :, :k]
-            acc_mat[:, :, :k] = part["test_acc"][:, :, :k]
-            hete[:k] = part["heterogeneity"][:k]
-            start_repeat = k
-            print(f"--resume: {k} completed repeat(s) loaded from "
-                  f"{partial_path}; continuing at repeat {k}")
-    elif args.resume and _is_writer(args):
-        print(f"--resume: no partial file at {partial_path}; "
-              "starting fresh")
-    if args.multihost:
-        # every process must enter the SAME repeats (the sharded
-        # algorithms issue collectives): process 0's view of the
-        # partial is authoritative — hosts without a shared filesystem
-        # (or racing its visibility) would otherwise desync, with
-        # process 1 issuing repeat-0 all-reduces process 0 never joins.
-        # A config mismatch likewise aborts every process together.
-        import numpy as _np
-        from jax.experimental import multihost_utils
-
-        state = multihost_utils.broadcast_one_to_all(
-            _np.array([start_repeat, int(bad_config)], _np.int32))
-        start_repeat, bad_config = int(state[0]), bool(state[1])
-    if bad_config:
-        raise SystemExit(2)
-    if args.resume and args.multihost and start_repeat:
-        # only process 0 loaded the finished repeats' metrics; that is
-        # fine — they are only consumed by the process-0 writer
-        print(f"--resume (multihost): starting at repeat {start_repeat}")
+    start_repeat = _resume_start(args, partial_path,
+                                 train_mat, error_mat, acc_mat, hete)
 
     if args.profile and args.backend != "jax":
         print("--profile captures a jax.profiler trace; ignored for "
@@ -284,6 +225,14 @@ def _is_writer(args) -> bool:
     return jax.process_index() == 0
 
 
+# keys added to _resume_config after the partial format shipped, with
+# the argparse default they had when absent — a partial missing one was
+# by construction a run at that default (e.g. a pre---model file IS a
+# linear run), and a strict comparison would throw away its finished
+# repeats over a key that could not have differed
+_RESUME_LEGACY_DEFAULTS = {"model": "linear", "data_dir": "datasets"}
+
+
 def _resume_config(args) -> dict:
     """The run configuration a partial result file is only valid under:
     everything that shapes a repeat's trajectory (--shard is excluded —
@@ -294,6 +243,68 @@ def _resume_config(args) -> dict:
         "round", "batch_size", "alpha_Dirk", "seed", "lr_mode",
         "sequential", "participation", "server_opt", "server_lr",
         "data_dir", "model")}
+
+
+def _resume_start(args, partial_path, train_mat, error_mat, acc_mat,
+                  hete) -> int:
+    """Resolve where the repeat loop starts: load a config-signed
+    partial under --resume (filling the finished repeats' metric
+    columns), set a foreign partial aside otherwise, and under
+    multihost broadcast process 0's verdict so every host enters the
+    SAME repeats (the sharded algorithms issue collectives; a host
+    racing the partial's filesystem visibility would desync into
+    all-reduces nobody else joins). A config mismatch aborts every
+    process together."""
+    start_repeat = 0
+    bad_config = False
+    if (not args.resume and os.path.exists(partial_path)
+            and _is_writer(args)):
+        # a fresh run must not clobber durable progress a preempted run
+        # left behind (its first completed repeat would overwrite a
+        # partial holding many): set it aside, recoverable
+        bak = partial_path + ".bak"
+        os.replace(partial_path, bak)
+        print(f"warning: {partial_path} exists from an earlier "
+              "(interrupted?) run but --resume was not given; moved it "
+              f"to {bak} so this fresh run cannot clobber that "
+              "progress", file=sys.stderr)
+    elif args.resume and os.path.exists(partial_path) and _is_writer(args):
+        with open(partial_path, "rb") as f:
+            part = pickle.load(f)
+        saved_cfg = {**_RESUME_LEGACY_DEFAULTS, **part["config"]}
+        if saved_cfg != _resume_config(args):
+            bad_config = True
+            print(f"--resume: {partial_path} was written under a "
+                  f"different configuration\n  saved: {saved_cfg}\n"
+                  f"  now:   {_resume_config(args)}\nRemove the partial "
+                  "file to start over.", file=sys.stderr)
+        else:
+            k = min(int(part["done"]), args.n_repeats)
+            train_mat[:, :, :k] = part["train_loss"][:, :, :k]
+            error_mat[:, :, :k] = part["test_loss"][:, :, :k]
+            acc_mat[:, :, :k] = part["test_acc"][:, :, :k]
+            hete[:k] = part["heterogeneity"][:k]
+            start_repeat = k
+            print(f"--resume: {k} completed repeat(s) loaded from "
+                  f"{partial_path}; continuing at repeat {k}")
+    elif args.resume and _is_writer(args):
+        print(f"--resume: no partial file at {partial_path}; "
+              "starting fresh")
+    if args.multihost:
+        from jax.experimental import multihost_utils
+
+        state = multihost_utils.broadcast_one_to_all(
+            np.array([start_repeat, int(bad_config)], np.int32))
+        start_repeat, bad_config = int(state[0]), bool(state[1])
+        if args.resume and start_repeat:
+            # only process 0 loaded the finished repeats' metrics;
+            # that is fine — they are only consumed by the process-0
+            # writer
+            print("--resume (multihost): starting at repeat "
+                  f"{start_repeat}")
+    if bad_config:
+        raise SystemExit(2)
+    return start_repeat
 
 
 def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete,
@@ -331,7 +342,9 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete,
             # mesh-even padding: inert empty clients round every client
             # axis up to a multiple of the mesh (parallel.shard_setup)
             **({"client_multiple": args.shard} if args.shard else {}),
-            **({"model": args.model} if args.model != "linear" else {}),
+            # explicit default == default; the torch backend (linear
+            # only, argparse-guarded) swallows unknown kwargs
+            model=args.model,
         )
         if args.shard:
             from fedamw_tpu.parallel import make_mesh, shard_setup
