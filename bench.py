@@ -136,9 +136,10 @@ def bench_jax_best(ds, D, rounds, algorithm="FedAvg", **kw):
     saved = {k: os.environ.get(k) for k in ("FEDAMW_KERNEL",
                                             "FEDAMW_PSOLVER")}
     try:
-        # pin the baseline leg: 'auto' now resolves to pallas on TPU,
-        # so an unpinned first leg would silently run the pallas
-        # kernels and blind the cross-check (both legs identical)
+        # pin the baseline leg explicitly: this must stay the pure-XLA
+        # program regardless of what 'auto' resolves to (round 4
+        # briefly had auto->pallas-on-TPU; pinning keeps the
+        # cross-check valid under any future default)
         os.environ["FEDAMW_KERNEL"] = "xla"
         os.environ["FEDAMW_PSOLVER"] = "xla"
         xla = bench_jax(ds, D, rounds, algorithm=algorithm, **kw)
@@ -168,9 +169,12 @@ def bench_jax_best(ds, D, rounds, algorithm="FedAvg", **kw):
         if algorithm == "FedAMW":
             # isolate the p-solver's contribution: the round-4 window
             # measured pallas+pallas > xla+xla for FedAMW while the
-            # FedAvg leg showed the epoch kernel alone losing to XLA,
-            # so the mixed xla-epoch + pallas-psolver pair (the 'auto'
-            # default since that window) is a first-class candidate
+            # FedAvg leg showed the epoch kernel alone losing to XLA.
+            # The mixed xla-epoch + pallas-psolver pair is the
+            # first-class candidate whose leg print IS the isolated
+            # p-solver measurement the round-5 revert of the
+            # auto->pallas default is waiting on (aggregate.py:
+            # resolve_psolver_impl)
             main.insert(1, ("xla", "pallas"))
         fb = [("pallas", "pallas_nt"), ("pallas_col", "pallas")]
         failed = False

@@ -96,33 +96,30 @@ def resolve_psolver_impl(kernel_impl: str = "auto") -> str:
     (1, J) -> (J, 1) relayout; see ``_p_epoch_kernel``).
 
     Mirrors ``client.resolve_kernel_impl``: FEDAMW_PSOLVER overrides an
-    'auto' argument; otherwise 'auto' resolves to the Pallas kernel on
-    TPU backends, and to XLA everywhere else (the interpret-mode
-    kernels are test vehicles, far slower than XLA on CPU). Evidence
-    basis (round-4 window, tpu_artifacts/bench.json): the FedAMW
-    winner was the pallas+pallas PAIR — the p-solver kernel was only
-    timed together with the Pallas epoch kernel, while the FedAvg leg
-    showed that epoch kernel alone losing to XLA, so attributing the
-    pair's win to the p-solver is an inference, not yet an isolated
-    measurement. ``bench_jax_best`` now times the mixed xla+pallas
-    pair (this default) each window, so the next artifact either
-    confirms or reverses this choice. Oversized validation sets still
-    fall back to the XLA path inside ``_make_pallas_solve``
-    (epoch-gather limit).
+    'auto' argument; otherwise 'auto' resolves to XLA on every backend
+    (the interpret-mode kernels are test vehicles, far slower than XLA
+    on CPU). Round 4 briefly flipped 'auto' to the Pallas kernel on TPU
+    backends; round 5 reverted that pending hardware evidence, because
+    (a) the only committed on-chip parity log (tpu_artifacts/pallas.log,
+    round-4 window) FAILED the four psolver comparisons at the
+    then-current rtol=1e-4 — the loosened tolerance has never run on
+    hardware — and (b) the perf basis was the pallas+pallas PAIR win in
+    the round-4 bench, an inference about the p-solver alone, not an
+    isolated measurement. ``bench_jax_best`` times the mixed
+    xla-epoch + pallas-psolver pair every window; 'auto' flips back to
+    pallas-on-TPU only when a window commits BOTH a green
+    tests/test_pallas_tpu.py at HEAD AND a mixed-pair bench leg beating
+    the pure-XLA leg. Oversized validation sets would still fall back
+    to the XLA path inside ``_make_pallas_solve`` (epoch-gather limit).
     """
     import os
-
-    import jax
-
-    from .client import _TPU_BACKENDS
 
     allowed = ("xla", "pallas", "pallas_interpret",
                "pallas_nt", "pallas_nt_interpret")
     if kernel_impl == "auto":
         forced = os.environ.get("FEDAMW_PSOLVER", "").strip().lower()
         if not forced:
-            return ("pallas"
-                    if jax.default_backend() in _TPU_BACKENDS else "xla")
+            return "xla"
         if forced not in allowed:
             # a typo must not silently run XLA during an unattended
             # hardware-validation window (mirrors FEDAMW_KERNEL's check)

@@ -141,10 +141,11 @@ def resolve_kernel_impl(kernel_impl: str, params,
     # workload — the XLA scan beat the fused Pallas epoch kernel
     # (winner impl "xla"; the pallas leg lowered, matched accuracy,
     # and was slower), so 'auto' keeps resolving to XLA here. The
-    # p-solver is the opposite case — its fused kernel was in the
-    # measured FedAMW winner — and its 'auto' prefers Pallas on TPU
-    # (see aggregate.resolve_psolver_impl). bench.py auto-times every
-    # impl each window, so this decision is re-checked per artifact.
+    # p-solver's 'auto' is also XLA since the round-5 revert — its
+    # round-4 pallas-on-TPU flip rested on a red hardware log (see
+    # aggregate.resolve_psolver_impl for the flip-back conditions).
+    # bench.py auto-times every impl each window, so both decisions
+    # are re-checked per artifact.
     return "xla"
 
 

@@ -140,13 +140,13 @@ def test_dryrun_multichip_succeeds_without_backend_query():
 
 
 def test_bench_jax_best_leg_policy(monkeypatch):
-    """The in-process contract of bench_jax_best after the round-4
-    kernel-default flip: the baseline leg must run with both impl env
-    vars pinned to xla (an unpinned leg would resolve 'auto' to pallas
-    on TPU and blind the accuracy cross-check), the FedAMW candidate
-    list must include the mixed xla+pallas pair (the 'auto' default,
-    so each window measures it), the fastest accuracy-matching pair
-    must win, and the caller's env must be restored."""
+    """The in-process contract of bench_jax_best: the baseline leg must
+    run with both impl env vars pinned to xla (pinning keeps the
+    accuracy cross-check valid under any 'auto' default), the FedAMW
+    candidate list must include the mixed xla+pallas pair (the isolated
+    p-solver measurement the round-5 auto-revert is waiting on), the
+    fastest accuracy-matching pair must win, and the caller's env must
+    be restored."""
     import bench as bench_mod
 
     calls = []
@@ -173,7 +173,7 @@ def test_bench_jax_best_leg_policy(monkeypatch):
     ups, acc, dt, impl = bench_mod.bench_jax_best(
         None, 64, 2, algorithm="FedAMW")
     assert calls[0] == ("xla", "xla")  # pinned baseline leg
-    assert ("xla", "pallas") in calls  # the auto default is measured
+    assert ("xla", "pallas") in calls  # isolated p-solver leg measured
     assert impl == "xla+pallas" and ups == 160.0
     # caller env restored exactly
     assert os.environ["FEDAMW_KERNEL"] == "caller-sentinel"

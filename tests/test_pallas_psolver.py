@@ -104,7 +104,9 @@ def test_resolve_psolver_impl(monkeypatch):
     monkeypatch.setenv("FEDAMW_PSOLVER", "xla")
     assert resolve_psolver_impl("auto") == "xla"
     monkeypatch.delenv("FEDAMW_PSOLVER")
-    # on CPU (the test env) auto resolves to xla
+    # with no override, auto resolves to xla on EVERY backend (round-5
+    # revert of the round-4 pallas-on-TPU flip — the hardware evidence
+    # for the kernel was a red log; see resolve_psolver_impl)
     assert resolve_psolver_impl("auto") == "xla"
 
 
