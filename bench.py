@@ -483,8 +483,8 @@ def main():
     _params = linear_model().init(jax.random.PRNGKey(0), D,
                                   ds.num_classes)
     n_mean = 0.8 * float(np.mean([len(p) for p in ds.parts]))
-    flops_upd = client_update_flops(fwd_flops_per_sample(_params),
-                                    EPOCHS, n_mean)
+    _fwd, _fwd_basis = fwd_flops_per_sample(_params, with_provenance=True)
+    flops_upd = client_update_flops(_fwd, EPOCHS, n_mean)
     headline = {
         "metric": "client_updates_per_sec",
         "value": round(jax_ups, 2),
@@ -495,6 +495,10 @@ def main():
         "impl": jax_impl,
         "platform": platform,
         "flops_per_update": round(flops_upd),
+        # counting basis travels with the record (round-4 advisor):
+        # the linear flagship is all-2-D so this is 'gemm-formula',
+        # directly comparable only to same-basis scale_bench rows
+        "flops_basis": _fwd_basis,
         "achieved_gflops": round(jax_ups * flops_upd / 1e9, 2),
     }
     if ref is not None:

@@ -91,6 +91,15 @@ def parse_args():
                          "global params + mixture weights under DIR "
                          "(orbax when available; the reference persists "
                          "metrics only)")
+    ap.add_argument("--lr", type=float, default=None,
+                    help="extension: override the registry learning "
+                         "rate (config.py pins the reference's "
+                         "per-dataset value; the parallel client "
+                         "semantics can need a different operating "
+                         "point — see PARITY.md §2)")
+    ap.add_argument("--lr_p", type=float, default=None,
+                    help="extension: override the registry mixture-"
+                         "weight learning rate (FedAMW p-solver)")
     ap.add_argument("--resume", action="store_true",
                     help="preemption durability: a partial result file "
                          "(exp1_{dataset}.partial.pkl, written after "
@@ -200,6 +209,16 @@ def main():
         "test_acc": acc_mat,
         "heterogeneity": hete,
         "name": names,
+        # extra key beyond the reference schema (exp.py:132-143 keeps
+        # every reference key): lets results_report.py pick the MSE vs
+        # accuracy table from the recorded task instead of inferring it
+        # from all-zero accuracies (round-4 advisor — a fully-degenerate
+        # classification run must not render as a regression table).
+        # Derived exactly as the data layer does (datasets.py:88):
+        # the name list wins over the registry, because the LIBSVM
+        # regression names have no registry block and would fall back
+        # to _DEFAULT's 'classification'
+        "task": _task_type(args.dataset, params),
     }
     if not _is_writer(args):
         # SPMD: every host computed identical matrices; one writer
@@ -213,6 +232,16 @@ def main():
     # the reference-schema result pickle cannot, so a later
     # `--resume --n_repeats M` (M > this run's count) extends the
     # experiment without recomputing finished repeats
+
+
+def _task_type(dataset: str, params: dict) -> str:
+    """The dataset's true task, via the data layer's own rule
+    (``data/datasets.py:88``): the LIBSVM regression name list wins
+    over the registry (those names have no registry block, so
+    ``params["task_type"]`` alone would misreport 'classification')."""
+    from fedamw_tpu.data.svmlight import is_regression
+
+    return "regression" if is_regression(dataset) else params["task_type"]
 
 
 def _is_writer(args) -> bool:
@@ -230,7 +259,8 @@ def _is_writer(args) -> bool:
 # by construction a run at that default (e.g. a pre---model file IS a
 # linear run), and a strict comparison would throw away its finished
 # repeats over a key that could not have differed
-_RESUME_LEGACY_DEFAULTS = {"model": "linear", "data_dir": "datasets"}
+_RESUME_LEGACY_DEFAULTS = {"model": "linear", "data_dir": "datasets",
+                           "lr": None, "lr_p": None}
 
 
 def _resume_config(args) -> dict:
@@ -242,7 +272,7 @@ def _resume_config(args) -> dict:
         "dataset", "backend", "D", "num_partitions", "local_epoch",
         "round", "batch_size", "alpha_Dirk", "seed", "lr_mode",
         "sequential", "participation", "server_opt", "server_lr",
-        "data_dir", "model")}
+        "data_dir", "model", "lr", "lr_p")}
 
 
 def _resume_start(args, partial_path, train_mat, error_mat, acc_mat,
@@ -261,8 +291,14 @@ def _resume_start(args, partial_path, train_mat, error_mat, acc_mat,
             and _is_writer(args)):
         # a fresh run must not clobber durable progress a preempted run
         # left behind (its first completed repeat would overwrite a
-        # partial holding many): set it aside, recoverable
+        # partial holding many): set it aside, recoverable. Uniquify —
+        # two consecutive fresh runs must not destroy the first backup
+        # either (round-4 advisor)
         bak = partial_path + ".bak"
+        n = 1
+        while os.path.exists(bak):
+            n += 1
+            bak = f"{partial_path}.bak{n}"
         os.replace(partial_path, bak)
         print(f"warning: {partial_path} exists from an earlier "
               "(interrupted?) run but --resume was not given; moved it "
@@ -322,8 +358,8 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete,
                   "the linear flagship)")
         kernel_type = "linear"
     k_par = params["kernel_par"]
-    lr = params["lr"]
-    lr_p = params.get("lr_p", 1e-3)
+    lr = params["lr"] if args.lr is None else args.lr
+    lr_p = (params.get("lr_p", 1e-3) if args.lr_p is None else args.lr_p)
     lr_p_os = params.get("lr_p_os", lr_p)
     mu = params["lambda_prox"]
     lam = params["lambda_reg"]

@@ -88,7 +88,8 @@ def _kernel_env() -> tuple:
 
     return (os.environ.get("FEDAMW_KERNEL", ""),
             os.environ.get("FEDAMW_PSOLVER", ""),
-            os.environ.get("FEDAMW_SCAN_UNROLL", ""))
+            os.environ.get("FEDAMW_SCAN_UNROLL", ""),
+            os.environ.get("FEDAMW_P_GUARD", ""))
 
 
 @functools.lru_cache(maxsize=64)
@@ -96,7 +97,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                           epoch, batch_size, n_maxes, counts, rounds,
                           aggregation, lr_p, val_batch_size, n_val,
                           sequential, shard_factor, verbose=False,
-                          participation=1.0, kernel_env=("", "", ""),
+                          participation=1.0, kernel_env=("", "", "", ""),
                           start_round=0, stop_round=None,
                           server_opt="none", server_lr=1.0):
     # stop_round: required resolved int (the sole caller, _round_based,
@@ -298,7 +299,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
 
 @functools.lru_cache(maxsize=64)
 def _cached_centralized_trainer(init_fn, apply_fn, task, D, num_classes,
-                                epoch, batch_size, n, kernel_env=("", "", "")):
+                                epoch, batch_size, n, kernel_env=("", "", "", "")):
     """One jitted program for the Centralized baseline: init, the long
     pooled local run, eval — one dispatch (see _cached_round_trainer on
     why eager steps are expensive on remote-attached TPUs)."""
@@ -363,7 +364,7 @@ def Centralized(
 @functools.lru_cache(maxsize=64)
 def _cached_oneshot_local(init_fn, apply_fn, task, D, num_classes,
                           num_clients, epoch, batch_size, n_maxes, counts,
-                          sequential, shard_factor, kernel_env=("", "", "")):
+                          sequential, shard_factor, kernel_env=("", "", "", "")):
     """Jitted one-shot local phase: init + every client training
     epoch*Round epochs from the same init (``tools.py:261-267``)."""
     round_fn = make_bucketed_round(apply_fn, task, epoch, batch_size,
@@ -398,7 +399,7 @@ def _cached_distributed_finish(apply_fn, task):
 
 @functools.lru_cache(maxsize=64)
 def _cached_oneshot_finish(apply_fn, task, rounds, lr_p, val_batch_size,
-                           n_val, kernel_env=("", "", "")):
+                           n_val, kernel_env=("", "", "", "")):
     """FedAMW_OneShot mixture phase: ``rounds`` iterations of plain-SGD
     p-learning over cached logits, re-aggregating and evaluating after
     each (``tools.py:279-326``). Returns one flat

@@ -129,6 +129,83 @@ def resolve_psolver_impl(kernel_impl: str = "auto") -> str:
     return kernel_impl
 
 
+def resolve_p_guard(p_guard: str = "auto") -> str:
+    """Resolve the opt-in mixture-weight guard: 'none' (default —
+    reference semantics, p unconstrained, ``tools.py:417-423``),
+    'simplex' (Euclidean projection onto the probability simplex after
+    every p step), or 'clip'/'clip:R' (rescale p to L2 norm <= R,
+    default R=1, when it exceeds it).
+
+    'auto' reads FEDAMW_P_GUARD (same pattern as FEDAMW_PSOLVER). The
+    guard exists because the UNCONSTRAINED solver faithfully diverges
+    to NaN off the tuned registry (TUNING_regression.md: 4/16 trials
+    at lr_p >= 0.005) — registry-less users can opt into stability
+    without changing the default reference semantics.
+    """
+    import os
+
+    if p_guard == "auto":
+        p_guard = (os.environ.get("FEDAMW_P_GUARD", "").strip().lower()
+                   or "none")
+    if p_guard.startswith("clip:"):
+        # validate the radius HERE, with the env var named — a bare
+        # float() crash later (or a sign-flipping negative radius,
+        # silently) would never mention FEDAMW_P_GUARD
+        try:
+            radius = float(p_guard.split(":", 1)[1])
+        except ValueError:
+            radius = -1.0
+        if radius <= 0:
+            raise ValueError(
+                f"p_guard={p_guard!r} (FEDAMW_P_GUARD): the clip "
+                "radius must be a positive number, e.g. 'clip:2.5'")
+    elif p_guard not in ("none", "simplex", "clip"):
+        raise ValueError(
+            f"p_guard={p_guard!r}; expected 'none', 'simplex', 'clip' "
+            "or 'clip:R'")
+    return p_guard
+
+
+def project_simplex(v: jax.Array, valid=None) -> jax.Array:
+    """Euclidean projection of ``v`` onto the probability simplex
+    (sort-based, O(J log J), jit-friendly: no data-dependent shapes).
+
+    With a 0/1 ``valid`` mask the projection runs over the valid
+    subset only — invalid (padded) entries project to exactly 0 and
+    the valid entries sum to 1, preserving the padded-client
+    invariant the unguarded solver keeps via gradient masking.
+    """
+    J = v.shape[0]
+    if valid is None:
+        valid = jnp.ones(J, v.dtype)
+    # invalid entries sort to the bottom and fail the support test
+    u = jnp.sort(jnp.where(valid > 0, v, -jnp.inf))[::-1]
+    css = jnp.cumsum(jnp.where(jnp.isfinite(u), u, 0.0))
+    k = jnp.arange(1, J + 1, dtype=v.dtype)
+    cond = (u + (1.0 - css) / k > 0) & jnp.isfinite(u)
+    rho = jnp.sum(cond)  # support size >= 1 whenever any entry valid
+    theta = (css[jnp.maximum(rho - 1, 0)] - 1.0) / jnp.maximum(
+        rho.astype(v.dtype), 1.0)
+    return jnp.where(valid > 0, jnp.maximum(v - theta, 0.0), 0.0)
+
+
+def _make_guard(p_guard: str):
+    """None for 'none'; else ``guard(p, valid) -> p`` applied after
+    every p SGD step (projected SGD; the momentum buffer is left
+    untouched, the standard projected-SGD form)."""
+    if p_guard == "none":
+        return None
+    if p_guard == "simplex":
+        return project_simplex
+    radius = float(p_guard.split(":", 1)[1]) if ":" in p_guard else 1.0
+
+    def clip(p, valid=None):
+        norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        return p * jnp.minimum(1.0, radius / jnp.maximum(norm, 1e-30))
+
+    return clip
+
+
 def make_p_solver(
     task: str,
     n_val: int,
@@ -136,6 +213,7 @@ def make_p_solver(
     lr_p: float = 1e-3,
     momentum: float = 0.0,
     kernel_impl: str = "auto",
+    p_guard: str = "auto",
 ):
     """Build the jitted mixture-weight SGD solver.
 
@@ -161,6 +239,7 @@ def make_p_solver(
     from ..ops.metrics import top1_correct
     from .batching import epoch_batches, weighted_epoch_metrics
 
+    guard = _make_guard(resolve_p_guard(p_guard))
     tx = optax.sgd(lr_p, momentum=momentum if momentum > 0 else None)
 
     def init_opt_state(p):
@@ -204,6 +283,8 @@ def make_p_solver(
                     g = g * client_valid
                 updates, opt_state = tx.update(g, opt_state, p)
                 p = optax.apply_updates(p, updates)
+                if guard is not None:
+                    p = guard(p, client_valid)
                 cnt = jnp.sum(bv)
                 if task == "classification":
                     correct = jnp.sum(top1_correct(out, yb) * bv)
@@ -238,6 +319,20 @@ def make_p_solver(
         return p, opt_state, ep_losses[-1], ep_accs[-1]
 
     kernel_impl = resolve_psolver_impl(kernel_impl)
+    if guard is not None:
+        if kernel_impl.startswith("pallas"):
+            # the Mosaic kernel pins the reference's unconstrained
+            # update in-kernel — it cannot honor a guard, and silently
+            # running XLA under an explicit pallas pin would poison
+            # hardware-validation provenance (every 'pallas'-labeled
+            # bench leg would actually measure XLA). Refuse loudly,
+            # same policy as resolve_psolver_impl's typo check.
+            raise ValueError(
+                f"p-solver kernel {kernel_impl!r} cannot run with an "
+                "active p_guard (the fused kernel implements the "
+                "reference's unconstrained update); unset "
+                "FEDAMW_P_GUARD or select the XLA p-solver")
+        return solve, init_opt_state
     if kernel_impl.startswith("pallas"):
         return _make_pallas_solve(
             task, n_val, batch_size, lr_p, momentum,

@@ -41,18 +41,24 @@ def fwd_flops_per_sample(params, apply_fn=None, d=None,
     count comes from XLA's own cost model on the lowered single-sample
     forward (exact for any model, including elementwise ops).
 
-    ``with_provenance=True`` returns ``(flops, exact)`` instead of the
-    bare count: ``exact=False`` means the GEMM formula was applied to a
-    model it undercounts (conv leaves present but the runtime's
-    cost_analysis was unavailable) — callers must LABEL such records
-    (scale_bench attaches a ``flops_note``), not just rely on the
-    stderr warning, because the JSON artifact is what gets committed.
+    ``with_provenance=True`` returns ``(flops, basis)`` instead of the
+    bare count, where ``basis`` is the counting method actually used:
+    ``'xla-cost-model'`` (cost_analysis on the lowered forward — counts
+    elementwise/bias/activation work too), ``'gemm-formula'`` (the
+    matmul-only 2·in·out count, exact regime for all-2-D models), or
+    ``'gemm-formula-undercount'`` (the formula applied to a model with
+    conv leaves because cost_analysis was unavailable). Emitters must
+    attach the basis to EVERY record they write — the two bases are not
+    directly comparable, and provenance only on the undercount case
+    left the rest ambiguous (round-4 advisor); the undercount case
+    additionally warrants a human-readable note, because the JSON
+    artifact is what gets committed.
     """
     import jax
 
     leaves = jax.tree.leaves(params)
     has_high_rank = any(np.ndim(w) > 2 for w in leaves)
-    exact = True
+    basis = "gemm-formula"
     if apply_fn is not None and d is not None and has_high_rank:
         import jax.numpy as jnp
 
@@ -66,7 +72,8 @@ def fwd_flops_per_sample(params, apply_fn=None, d=None,
             cost = cost[0] if cost else {}
         flops = (cost or {}).get("flops", 0.0)
         if flops:
-            return (int(flops), True) if with_provenance else int(flops)
+            return ((int(flops), "xla-cost-model") if with_provenance
+                    else int(flops))
         # the GEMM formula below is WRONG for >2-D leaves (it would
         # count only the linear head, a ~10x undercount for convs) —
         # never degrade silently on a runtime whose cost_analysis is
@@ -79,16 +86,16 @@ def fwd_flops_per_sample(params, apply_fn=None, d=None,
             "UNDERCOUNTS models with conv kernels — treat the FLOPs "
             "fields of this record as a lower bound",
             RuntimeWarning, stacklevel=2)
-        exact = False
+        basis = "gemm-formula-undercount"
     elif has_high_rank:
         # no apply_fn/d to lower with: same undercount, same contract
-        exact = False
+        basis = "gemm-formula-undercount"
     flops = sum(
         2 * int(np.prod(np.shape(w)))
         for w in leaves
         if np.ndim(w) == 2
     )
-    return (flops, exact) if with_provenance else flops
+    return (flops, basis) if with_provenance else flops
 
 
 def client_update_flops(fwd_per_sample: float, epochs: int,

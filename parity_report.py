@@ -37,7 +37,7 @@ def final_acc(res):
     return np.asarray(res["test_acc"])[:, -1, :]
 
 
-def main(jax_pkl, torch_pkl):
+def main(jax_pkl, torch_pkl, note=None):
     import os
 
     rj, rt = load_results(jax_pkl), load_results(torch_pkl)
@@ -55,6 +55,8 @@ def main(jax_pkl, torch_pkl):
     print("Dirichlet alpha=0.01, D=2000 RFF, 2 local epochs, batch 32 —")
     print("the reference's constants, `/root/reference/exp.py:31-41` —")
     print("unless the run that produced the pickles overrode them).")
+    if note:
+        print(note)
     print("Parity per algorithm =")
     print(f"|Δmean| <= {PRACTICAL_BAND} accuracy point (practical")
     print("equivalence) OR the reference's own t-test (threshold 1.812,")
@@ -134,4 +136,7 @@ def main(jax_pkl, torch_pkl):
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1], sys.argv[2]))
+    # optional third arg: a sentence appended to the header describing
+    # deliberate overrides (e.g. "This table's runs override lr=8.0 …")
+    sys.exit(main(sys.argv[1], sys.argv[2],
+                  note=sys.argv[3] if len(sys.argv) > 3 else None))

@@ -25,9 +25,17 @@ def final_acc(res):
 
 
 def is_regression(res):
-    """Regression artifacts carry acc==0.0 everywhere (the accuracy
-    metric is classification-only; ``fedcore/evaluate.py``) — the
-    meaningful final metric is then test_loss (MSE, lower better)."""
+    """True when the artifact's meaningful final metric is test_loss
+    (MSE, lower better) rather than accuracy.
+
+    Artifacts written since the ``task`` key shipped carry the task
+    type explicitly (``exp.py`` records the registry's task_type);
+    only legacy pickles fall back to the all-zero-accuracy inference
+    (the accuracy metric is classification-only,
+    ``fedcore/evaluate.py``) — which a fully-degenerate classification
+    run could fool, hence the recorded key (round-4 advisor)."""
+    if "task" in res:
+        return res["task"] == "regression"
     return bool(np.allclose(np.asarray(res["test_acc"]), 0.0))
 
 

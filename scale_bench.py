@@ -67,7 +67,7 @@ def run_config(name, ds, model, kernel_type, D, num_clients, rounds,
     params = setup.model.init(jax.random.PRNGKey(0), setup.D,
                               setup.num_classes)
     n_mean = float(np.mean(np.asarray(setup.sizes)))
-    fwd, fwd_exact = fwd_flops_per_sample(
+    fwd, fwd_basis = fwd_flops_per_sample(
         params, apply_fn=setup.model.apply, d=setup.D,
         with_provenance=True)
     flops_upd = client_update_flops(fwd, epoch, n_mean)
@@ -92,6 +92,11 @@ def run_config(name, ds, model, kernel_type, D, num_clients, rounds,
             "rounds": rounds,
             "buckets": buckets,
             "flops_per_update": round(flops_upd),
+            # counting basis on EVERY record (round-4 advisor): conv
+            # rows (xla-cost-model) count elementwise/bias/ReLU work
+            # the GEMM rows' matmul-only formula does not, so rows are
+            # only comparable within a basis
+            "flops_basis": fwd_basis,
             "achieved_gflops": round(
                 setup.num_clients * rounds / dt * flops_upd / 1e9, 2),
         }
@@ -101,7 +106,7 @@ def run_config(name, ds, model, kernel_type, D, num_clients, rounds,
             # is higher than this field — label rather than mislabel
             rec["flops_note"] = ("client local-SGD GEMMs only; excludes "
                                  "p-solver/logit work")
-        if not fwd_exact:
+        if fwd_basis == "gemm-formula-undercount":
             # conv leaves counted by the GEMM formula (runtime without
             # cost_analysis): the artifact itself must say so — the
             # stderr warning does not travel with the JSON

@@ -102,6 +102,16 @@ def test_results_report_regression_mode():
     dl_row = [ln for ln in md.splitlines() if ln.startswith("| DL ")][0]
     assert "significantly worse" in dl_row
 
+    # the recorded task key (round-4 advisor) beats metric inference:
+    # a fully-degenerate classification run (all-zero accuracy) must
+    # render as an accuracy table, not a regression MSE table
+    res["task"] = "classification"
+    assert not rr.is_regression(res)
+    assert "final test acc" in rr.render_markdown(res)
+    res["task"] = "regression"
+    assert rr.is_regression(res)
+    del res["task"]
+
     res["test_acc"] = np.full((6, 4, 5), 50.0)
     res["test_acc"][0] = 99.0  # CL best on accuracy
     assert not rr.is_regression(res)

@@ -303,6 +303,16 @@ def test_conv_flops_use_xla_cost_model():
     assert (fwd_flops_per_sample(lp)
             == fwd_flops_per_sample(lp, apply_fn=lm.apply, d=2000)
             == 2 * 2000 * 2)
+    # provenance names the basis actually used on every path (round-4
+    # advisor: emitters stamp it on each record, so the two
+    # non-comparable counting bases can never be conflated silently)
+    assert fwd_flops_per_sample(
+        lp, with_provenance=True) == (2 * 2000 * 2, "gemm-formula")
+    assert fwd_flops_per_sample(
+        p, apply_fn=m.apply, d=784,
+        with_provenance=True)[1] == "xla-cost-model"
+    assert fwd_flops_per_sample(
+        p, with_provenance=True)[1] == "gemm-formula-undercount"
 
 
 def test_conv_fedamw_learned_mixture():

@@ -1,0 +1,159 @@
+"""Opt-in mixture-weight guard (round-4 verdict #8).
+
+The reference learns p UNCONSTRAINED (``functions/tools.py:417-423``)
+and the framework keeps that as the default — TUNING_regression.md
+shows the faithful consequence: 4/16 regression sweep trials diverge
+to NaN at lr_p >= 0.005. FEDAMW_P_GUARD (or make_p_solver's p_guard
+argument) opts into projected-SGD stability for users off the tuned
+registry without touching reference semantics.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedamw_tpu.fedcore.aggregate import (make_p_solver, project_simplex,
+                                          resolve_p_guard)
+
+
+def _project_simplex_np(v):
+    """Reference implementation (Held et al. / Duchi et al. 2008)."""
+    u = np.sort(v)[::-1]
+    css = np.cumsum(u)
+    rho = np.nonzero(u + (1.0 - css) / np.arange(1, len(v) + 1) > 0)[0][-1]
+    theta = (css[rho] - 1.0) / (rho + 1.0)
+    return np.maximum(v - theta, 0.0)
+
+
+def test_resolve_p_guard(monkeypatch):
+    monkeypatch.delenv("FEDAMW_P_GUARD", raising=False)
+    assert resolve_p_guard("auto") == "none"  # reference default
+    assert resolve_p_guard("simplex") == "simplex"
+    assert resolve_p_guard("clip:2.5") == "clip:2.5"
+    monkeypatch.setenv("FEDAMW_P_GUARD", "simplex")
+    assert resolve_p_guard("auto") == "simplex"
+    with pytest.raises(ValueError):
+        resolve_p_guard("simplx")
+    # a malformed or sign-flipping clip radius fails HERE, naming the
+    # env var — not later as a bare float() crash or silent negation
+    for bad in ("clip:-1", "clip:abc", "clip:0"):
+        with pytest.raises(ValueError, match="FEDAMW_P_GUARD"):
+            resolve_p_guard(bad)
+
+
+def test_guard_refuses_pallas_kernel(monkeypatch):
+    """An active guard + an explicit Pallas p-solver pin must refuse
+    loudly: the fused kernel implements the unconstrained reference
+    update, and silently running XLA under a pallas pin would poison
+    bench provenance (every 'pallas' leg would measure XLA)."""
+    monkeypatch.delenv("FEDAMW_P_GUARD", raising=False)
+    with pytest.raises(ValueError, match="p_guard"):
+        make_p_solver("classification", 48, 16, 1e-2, 0.9,
+                      kernel_impl="pallas_interpret", p_guard="simplex")
+    monkeypatch.setenv("FEDAMW_P_GUARD", "clip:2")
+    with pytest.raises(ValueError, match="p_guard"):
+        make_p_solver("classification", 48, 16, 1e-2, 0.9,
+                      kernel_impl="pallas_interpret")
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_project_simplex_matches_reference(seed):
+    v = np.random.RandomState(seed).randn(17).astype(np.float32) * 3
+    got = np.asarray(project_simplex(jnp.asarray(v)))
+    np.testing.assert_allclose(got, _project_simplex_np(v), rtol=1e-5,
+                               atol=1e-6)
+    assert got.min() >= 0 and abs(got.sum() - 1.0) < 1e-5
+    # a point already on the simplex is a fixed point
+    w = np.abs(v) / np.abs(v).sum()
+    np.testing.assert_allclose(
+        np.asarray(project_simplex(jnp.asarray(w))), w, rtol=1e-5,
+        atol=1e-6)
+
+
+def test_project_simplex_respects_valid_mask():
+    v = jnp.asarray([0.5, 0.9, -0.2, 3.0, 3.0], jnp.float32)
+    valid = jnp.asarray([1.0, 1.0, 1.0, 0.0, 0.0])
+    got = np.asarray(project_simplex(v, valid))
+    # padded entries stay exactly 0; the valid subset carries mass 1
+    np.testing.assert_array_equal(got[3:], np.zeros(2))
+    np.testing.assert_allclose(got[:3].sum(), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(got[:3],
+                               _project_simplex_np(np.asarray(v[:3])),
+                               rtol=1e-5, atol=1e-6)
+
+
+def _diverging_problem():
+    """A p-solver setting where the unconstrained reference update
+    blows up: large-magnitude regression logits + a hot lr_p (the
+    TUNING_regression.md cliff, shrunk to test size — the MSE gradient
+    is ~A·p, so a step size past 2/λmax(A) doubles p every step)."""
+    rng = np.random.RandomState(7)
+    n_val, J = 64, 8
+    logits = jnp.asarray(rng.randn(n_val, J, 1).astype(np.float32) * 40)
+    y = jnp.asarray(rng.randn(n_val).astype(np.float32))
+    p0 = jnp.ones(J, jnp.float32) / J
+    return n_val, logits, y, p0
+
+
+@pytest.mark.parametrize("guard", ["simplex", "clip"])
+def test_guard_keeps_diverging_trial_finite(guard):
+    n_val, logits, y, p0 = _diverging_problem()
+    key = jax.random.PRNGKey(0)
+
+    s0, i0 = make_p_solver("regression", n_val, 16, 5e-3, 0.9,
+                           p_guard="none")
+    p_un = np.asarray(s0(logits, y, p0, i0(p0), key, 30)[0])
+    assert not np.all(np.isfinite(p_un)) or np.abs(p_un).max() > 1e6, (
+        "precondition: the unguarded trial must diverge for this test "
+        f"to mean anything (got max|p|={np.abs(p_un).max():.3g})")
+
+    sg, ig = make_p_solver("regression", n_val, 16, 5e-3, 0.9,
+                           p_guard=guard)
+    p_g = np.asarray(sg(logits, y, p0, ig(p0), key, 30)[0])
+    assert np.all(np.isfinite(p_g))
+    if guard == "simplex":
+        assert p_g.min() >= 0 and abs(p_g.sum() - 1.0) < 1e-4
+    else:
+        assert float(np.sqrt((p_g ** 2).sum())) <= 1.0 + 1e-5
+
+
+def test_guard_off_is_bitexact_reference_path():
+    """p_guard='none' must not perturb the default solver (the guard is
+    strictly additive)."""
+    n_val, J, C = 48, 5, 2
+    rng = np.random.RandomState(3)
+    logits = jnp.asarray(rng.randn(n_val, J, C).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, C, n_val).astype(np.int32))
+    p0 = jnp.ones(J, jnp.float32) / J
+    key = jax.random.PRNGKey(5)
+    s1, i1 = make_p_solver("classification", n_val, 16, 1e-2, 0.9)
+    s2, i2 = make_p_solver("classification", n_val, 16, 1e-2, 0.9,
+                           p_guard="none")
+    np.testing.assert_array_equal(
+        np.asarray(s1(logits, y, p0, i1(p0), key, 3)[0]),
+        np.asarray(s2(logits, y, p0, i2(p0), key, 3)[0]))
+
+
+def test_guard_env_reaches_fedamw_e2e(monkeypatch):
+    """FEDAMW_P_GUARD threads through the cached trainer factories into
+    a full FedAMW run (the env snapshot is part of the cache key, so a
+    guarded program is never reused unguarded and vice versa)."""
+    from fedamw_tpu.algorithms import FedAMW, prepare_setup
+    from fedamw_tpu.data import load_dataset
+
+    ds = load_dataset("digits", num_partitions=5, alpha=0.5)
+    setup = prepare_setup(ds, kernel_type="linear", seed=2,
+                          rng=np.random.RandomState(2))
+    kw = dict(lr=0.5, epoch=1, round=3, lambda_reg=1e-4, lr_p=1e-2,
+              seed=0, lr_mode="constant", return_state=True)
+    monkeypatch.delenv("FEDAMW_P_GUARD", raising=False)
+    res_un = FedAMW(setup, **kw)
+    monkeypatch.setenv("FEDAMW_P_GUARD", "simplex")
+    res_g = FedAMW(setup, **kw)
+    p_g = np.asarray(res_g["p"])
+    assert p_g.min() >= -1e-6 and abs(p_g.sum() - 1.0) < 1e-4
+    # the guarded run took a genuinely different trajectory than the
+    # unconstrained default (if these match, the env never reached the
+    # solver — e.g. a stale cached program)
+    assert not np.allclose(p_g, np.asarray(res_un["p"]))
