@@ -118,6 +118,36 @@ def test_guard_keeps_diverging_trial_finite(guard):
         assert float(np.sqrt((p_g ** 2).sum())) <= 1.0 + 1e-5
 
 
+def test_guard_keeps_real_sweep_trial_finite(monkeypatch):
+    """The ACTUAL diverging operating point from the committed
+    regression sweep (TUNING_regression.md row: lr_p=0.005,
+    lambda_reg=1e-05 on synthetic_nonlinear — nan at R=50; reproduced
+    nan at R=10 here), end to end through FedAMW: unguarded it blows
+    up, FEDAMW_P_GUARD=simplex keeps every metric finite."""
+    from fedamw_tpu.algorithms import FedAMW, prepare_setup
+    from fedamw_tpu.config import get_parameter
+    from fedamw_tpu.data import load_dataset
+
+    params = get_parameter("synthetic_nonlinear")
+    rng = np.random.RandomState(7)
+    ds = load_dataset("synthetic_nonlinear", 50, 0.01, rng=rng)
+    setup = prepare_setup(ds, D=2000, kernel_par=params["kernel_par"],
+                          kernel_type=params["kernel_type"], seed=7,
+                          rng=rng)
+    kw = dict(lr=params["lr"], epoch=2, round=10, lambda_reg=1e-5,
+              lr_p=5e-3, seed=0, lr_mode="reference")
+    monkeypatch.delenv("FEDAMW_P_GUARD", raising=False)
+    tl_un = np.asarray(FedAMW(setup, **kw)["test_loss"])
+    assert not np.all(np.isfinite(tl_un)), (
+        "precondition: the sweep trial no longer diverges unguarded — "
+        "re-pick the operating point so this test still exercises the "
+        "cliff")
+    monkeypatch.setenv("FEDAMW_P_GUARD", "simplex")
+    res_g = FedAMW(setup, **kw)
+    for k in ("train_loss", "test_loss"):
+        assert np.all(np.isfinite(np.asarray(res_g[k]))), k
+
+
 def test_guard_off_is_bitexact_reference_path():
     """p_guard='none' must not perturb the default solver (the guard is
     strictly additive)."""
