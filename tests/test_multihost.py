@@ -156,7 +156,7 @@ def test_two_process_full_training_matches_single_process(tmp_path):
     outs = []
     try:
         for pr in procs:
-            out, _ = pr.communicate(timeout=280)
+            out, _ = pr.communicate(timeout=420)
             outs.append(out)
     finally:
         for pr in procs:

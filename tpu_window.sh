@@ -78,6 +78,20 @@ FEDAMW_TEST_PLATFORM=tpu timeout 1200 python -m pytest tests/test_pallas_tpu.py 
   >"$OUT/pallas.log" 2>&1
 rc=$?; echo "rc=$rc pallas"; [ $rc -eq 0 ] && touch "$OUT/pallas.ok"
 tail -3 "$OUT/pallas.log"
+# Consolidate the round-5 flip-back evidence in one place: the psolver
+# 'auto' default reverted to xla on a red log (aggregate.py:
+# resolve_psolver_impl); flipping back requires BOTH a green tier at
+# HEAD (rc above) AND the mixed xla+pallas FedAMW leg beating pure
+# xla (leg prints from step 1's bench). This block makes the window
+# log self-contained for that decision.
+{
+  echo "FLIPBACK-EVIDENCE pallas_tier_rc=$rc (0 = green at HEAD)"
+  # '^# FedAMW ' (not just 'leg') so the accuracy-discard and
+  # leg-unavailable diagnostics travel with the timing lines — a fast
+  # pair whose accuracy check discarded it must not read as a win
+  grep "^# FedAMW " "$OUT/bench.log" 2>/dev/null \
+    || echo "  (no FedAMW leg prints in $OUT/bench.log)"
+} | tee -a "$OUT/pallas.log"
 fi
 
 echo "[$(stamp)] probe"; probe
