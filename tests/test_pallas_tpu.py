@@ -27,34 +27,11 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-@pytest.mark.parametrize("layout", ["row", "col"])
-def test_epoch_kernel_lowers_and_matches_interpret(layout):
-    """Both layouts: "row" is the default; "col" is the transpose-free
-    fallback for the row kernel's in-kernel w.T/dz.T relayouts (the one
-    audited residual Mosaic risk) — if row fails to lower here, col is
-    the drop-in (FEDAMW_KERNEL=pallas_col)."""
-    import jax.numpy as jnp
-
-    from fedamw_tpu.fedcore.pallas_kernel import make_pallas_epoch
-
-    C, D, B, S = 2, 2000, 32, 7
-    rng = np.random.RandomState(0)
-    epoch = make_pallas_epoch("classification", C, D, B, S,
-                              layout=layout)
-    w0 = jnp.asarray(rng.randn(C, D).astype(np.float32) * 0.01)
-    Xe = jnp.asarray(rng.randn(S, B, D).astype(np.float32))
-    ye = jnp.asarray(rng.randint(0, C, (S, B)).astype(np.int32))
-    bv = jnp.ones((S, B), jnp.float32)
-    bv = bv.at[-1, 20:].set(0.0)  # partial last batch
-    scal = jnp.asarray([0.1, 0.01, 0.001], jnp.float32)
-    w, met = jax.jit(epoch)(w0, w0, Xe, ye, bv, scal)
-    w, met = np.asarray(w), np.asarray(met)
-
-    ref = make_pallas_epoch("classification", C, D, B, S, interpret=True,
-                            layout=layout)
-    w_i, met_i = jax.jit(ref)(w0, w0, Xe, ye, bv, scal)
-    np.testing.assert_allclose(w, np.asarray(w_i), rtol=1e-4, atol=1e-5)
-    np.testing.assert_allclose(met, np.asarray(met_i), rtol=1e-4)
+# File order is window-priority order: a short tunnel window that dies
+# mid-tier still certifies the tests that ran. The p-solver comparisons
+# lead — they are the round-5 flip-back gate (the auto default reverted
+# to xla on a red round-4 log); the epoch-kernel lowering checks and
+# the e2e run follow.
 
 
 @pytest.mark.parametrize("impl", ["pallas", "pallas_nt"])
@@ -110,6 +87,36 @@ def test_psolver_kernel_lowers_and_matches_xla(task, C, impl):
         f"default-precision drift {err:.3e} exceeds envelope "
         f"(4x XLA control gap {gap:.3e}, floor 2e-3)"
     )
+
+
+@pytest.mark.parametrize("layout", ["row", "col"])
+def test_epoch_kernel_lowers_and_matches_interpret(layout):
+    """Both layouts: "row" is the default; "col" is the transpose-free
+    fallback for the row kernel's in-kernel w.T/dz.T relayouts (the one
+    audited residual Mosaic risk) — if row fails to lower here, col is
+    the drop-in (FEDAMW_KERNEL=pallas_col)."""
+    import jax.numpy as jnp
+
+    from fedamw_tpu.fedcore.pallas_kernel import make_pallas_epoch
+
+    C, D, B, S = 2, 2000, 32, 7
+    rng = np.random.RandomState(0)
+    epoch = make_pallas_epoch("classification", C, D, B, S,
+                              layout=layout)
+    w0 = jnp.asarray(rng.randn(C, D).astype(np.float32) * 0.01)
+    Xe = jnp.asarray(rng.randn(S, B, D).astype(np.float32))
+    ye = jnp.asarray(rng.randint(0, C, (S, B)).astype(np.int32))
+    bv = jnp.ones((S, B), jnp.float32)
+    bv = bv.at[-1, 20:].set(0.0)  # partial last batch
+    scal = jnp.asarray([0.1, 0.01, 0.001], jnp.float32)
+    w, met = jax.jit(epoch)(w0, w0, Xe, ye, bv, scal)
+    w, met = np.asarray(w), np.asarray(met)
+
+    ref = make_pallas_epoch("classification", C, D, B, S, interpret=True,
+                            layout=layout)
+    w_i, met_i = jax.jit(ref)(w0, w0, Xe, ye, bv, scal)
+    np.testing.assert_allclose(w, np.asarray(w_i), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(met, np.asarray(met_i), rtol=1e-4)
 
 
 def test_fedamw_e2e_with_pallas_kernels(monkeypatch):
