@@ -100,6 +100,14 @@ def parse_args():
     ap.add_argument("--lr_p", type=float, default=None,
                     help="extension: override the registry mixture-"
                          "weight learning rate (FedAMW p-solver)")
+    ap.add_argument("--p_guard", type=str, default=None,
+                    metavar="none|simplex|clip[:R]",
+                    help="extension: opt-in mixture-weight guard "
+                         "(projected SGD on p). Default keeps the "
+                         "reference's unconstrained update — which "
+                         "faithfully diverges at hot lr_p "
+                         "(TUNING_regression.md); sets FEDAMW_P_GUARD "
+                         "for the run")
     ap.add_argument("--resume", action="store_true",
                     help="preemption durability: a partial result file "
                          "(exp1_{dataset}.partial.pkl, written after "
@@ -125,6 +133,22 @@ def parse_args():
     if args.model != "linear" and args.backend != "jax":
         ap.error("--model is a jax-backend extension (the torch twin "
                  "implements the reference's linear model only)")
+    if args.p_guard is not None:
+        if args.backend != "jax":
+            ap.error("--p_guard is a jax-backend extension (the torch "
+                     "twin pins the reference's unconstrained update)")
+        if args.p_guard.strip().lower() == "auto":
+            # 'auto' is resolve_p_guard's defer-to-env sentinel, not a
+            # guard; writing it into the env var would crash at
+            # trainer-build time, after earlier algorithms already ran
+            ap.error("--p_guard auto is not a guard value; omit the "
+                     "flag to defer to FEDAMW_P_GUARD")
+        from fedamw_tpu.fedcore.aggregate import resolve_p_guard
+
+        try:  # validate at the CLI boundary, not mid-run
+            resolve_p_guard(args.p_guard)
+        except ValueError as e:
+            ap.error(str(e))
     if args.multihost:
         if args.backend != "jax":
             ap.error("--multihost requires --backend jax")
@@ -148,6 +172,12 @@ def main():
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     args = parse_args()
+    if args.p_guard is not None:
+        # the guard resolves from this env var at trainer-build time
+        # (fedcore.aggregate.resolve_p_guard), and the env snapshot is
+        # part of the memoized-trainer cache key, so the flag cannot
+        # leak into or out of other runs in this process
+        os.environ["FEDAMW_P_GUARD"] = args.p_guard
     if args.multihost:
         # must land before any other JAX API: after this, jax.devices()
         # is GLOBAL and make_mesh() spans hosts — the same compiled
@@ -234,6 +264,23 @@ def main():
     # experiment without recomputing finished repeats
 
 
+def _effective_p_guard():
+    """The run's effective mixture-weight guard for the resume
+    signature — resolved from FEDAMW_P_GUARD (whether --p_guard wrote
+    it or the user exported it), canonicalized so equivalent spellings
+    compare equal; None when unguarded (the value legacy partials
+    carry)."""
+    from fedamw_tpu.fedcore.aggregate import resolve_p_guard
+
+    g = resolve_p_guard("auto")
+    if g == "none":
+        return None
+    if g == "clip" or g.startswith("clip:"):
+        radius = float(g.split(":", 1)[1]) if ":" in g else 1.0
+        return f"clip:{radius}"
+    return g
+
+
 def _task_type(dataset: str, params: dict) -> str:
     """The dataset's true task, via the data layer's own rule
     (``data/datasets.py:88``): the LIBSVM regression name list wins
@@ -260,7 +307,7 @@ def _is_writer(args) -> bool:
 # linear run), and a strict comparison would throw away its finished
 # repeats over a key that could not have differed
 _RESUME_LEGACY_DEFAULTS = {"model": "linear", "data_dir": "datasets",
-                           "lr": None, "lr_p": None}
+                           "lr": None, "lr_p": None, "p_guard": None}
 
 
 def _resume_config(args) -> dict:
@@ -268,11 +315,18 @@ def _resume_config(args) -> dict:
     everything that shapes a repeat's trajectory (--shard is excluded —
     sharded==unsharded is test-pinned, so resuming across a device-count
     change is sound)."""
-    return {k: getattr(args, k) for k in (
+    cfg = {k: getattr(args, k) for k in (
         "dataset", "backend", "D", "num_partitions", "local_epoch",
         "round", "batch_size", "alpha_Dirk", "seed", "lr_mode",
         "sequential", "participation", "server_opt", "server_lr",
         "data_dir", "model", "lr", "lr_p")}
+    # the EFFECTIVE guard, not the raw flag: FEDAMW_P_GUARD set
+    # directly (the documented env channel) must also sign the
+    # partial, or a preempted guarded run could silently mix with
+    # unguarded resumed repeats; canonicalized so equivalent
+    # spellings ('clip:1' vs 'clip:1.0') match
+    cfg["p_guard"] = _effective_p_guard()
+    return cfg
 
 
 def _resume_start(args, partial_path, train_mat, error_mat, acc_mat,
