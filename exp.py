@@ -178,6 +178,15 @@ def main():
         # part of the memoized-trainer cache key, so the flag cannot
         # leak into or out of other runs in this process
         os.environ["FEDAMW_P_GUARD"] = args.p_guard
+    if args.backend == "jax":
+        # validate the EFFECTIVE guard (flag or exported env) once,
+        # before any training: a bogus exported FEDAMW_P_GUARD must
+        # fail here, not at the first partial write after a completed
+        # repeat (round-5 review)
+        try:
+            _effective_p_guard()
+        except ValueError as e:
+            raise SystemExit(f"exp.py: error: {e}")
     if args.multihost:
         # must land before any other JAX API: after this, jax.devices()
         # is GLOBAL and make_mesh() spans hosts — the same compiled
@@ -307,7 +316,15 @@ def _is_writer(args) -> bool:
 # linear run), and a strict comparison would throw away its finished
 # repeats over a key that could not have differed
 _RESUME_LEGACY_DEFAULTS = {"model": "linear", "data_dir": "datasets",
-                           "lr": None, "lr_p": None, "p_guard": None}
+                           "lr": None, "lr_p": None,
+                           # p_guard: the guard feature and this
+                           # signature key shipped within hours of
+                           # each other (round 5) and no guarded
+                           # partial was ever written in between (all
+                           # committed partials predate the guard and
+                           # are unguarded), so a keyless partial IS
+                           # an unguarded run
+                           "p_guard": None}
 
 
 def _resume_config(args) -> dict:
@@ -324,8 +341,12 @@ def _resume_config(args) -> dict:
     # directly (the documented env channel) must also sign the
     # partial, or a preempted guarded run could silently mix with
     # unguarded resumed repeats; canonicalized so equivalent
-    # spellings ('clip:1' vs 'clip:1.0') match
-    cfg["p_guard"] = _effective_p_guard()
+    # spellings ('clip:1' vs 'clip:1.0') match. jax-only: the torch
+    # twin pins the reference's unconstrained update, so a leaked env
+    # var must neither sign a torch partial nor be able to abort its
+    # resume (round-5 review)
+    cfg["p_guard"] = (_effective_p_guard() if args.backend == "jax"
+                      else None)
     return cfg
 
 
