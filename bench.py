@@ -423,6 +423,18 @@ def main():
     import jax
 
     platform = jax.default_backend()
+    if os.environ.get("BENCH_STRICT_TPU"):
+        from fedamw_tpu.fedcore.client import _TPU_BACKENDS
+
+        # strict mode certifies TPU evidence: a healthy probe is not
+        # enough — a leaked JAX_PLATFORMS=cpu or BENCH_FORCE_FALLBACK
+        # (both honored above) would otherwise run the whole bench on
+        # CPU with rc=0 and let the window harvest mark a CPU capture
+        # green; strict dominates every downgrade path
+        if platform not in _TPU_BACKENDS:
+            print(f"# bench aborted: BENCH_STRICT_TPU set but the "
+                  f"resolved backend is {platform!r}", file=sys.stderr)
+            raise SystemExit(1)
 
     if os.environ.get("BENCH_SWEEP_ONLY"):
         # sweep-only run (tpu_window.sh step 5/5): skip the headline /

@@ -85,6 +85,27 @@ def test_bench_cpu_fallback_contract():
     assert "vs_baseline" not in lines[1]  # no baseline arm in fallback
 
 
+def test_bench_strict_tpu_refuses_cpu_backend():
+    """BENCH_STRICT_TPU certifies TPU evidence: with the resolved
+    backend CPU (a leaked JAX_PLATFORMS=cpu — honored by bench.py's
+    own config update), strict mode must abort BEFORE measuring
+    anything, or the window harvest could mark a CPU capture green
+    (tpu_window.sh relies on this; the probe alone cannot see an
+    in-process platform downgrade)."""
+    for leak in ({"JAX_PLATFORMS": "cpu"}, {"BENCH_FORCE_FALLBACK": "1"}):
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.update(BENCH_STRICT_TPU="1", **leak)
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=300,
+        )
+        assert out.returncode == 1, leak
+        assert "BENCH_STRICT_TPU set but the resolved backend" in out.stderr
+        assert not out.stdout.strip()  # no metric lines to mis-harvest
+
+
 def test_bench_sweep_only_contract():
     """BENCH_SWEEP_ONLY (tpu_window.sh step 5/5) must emit exactly the
     env-gated sweep JSON lines — bucket and unroll — and skip every
