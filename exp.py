@@ -536,6 +536,9 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete,
                     os.path.join(args.save_models,
                                  f"{args.dataset}_{name}_repeat{t}"),
                     res["params"], p=res["p"], round_idx=R, extra=extra,
+                    # the RFF draw makes the checkpoint self-contained
+                    # for serving RAW inputs (serving.ServingEngine)
+                    rff=getattr(setup, "rff", None),
                 )
                 print(f"{name}: checkpoint -> {where}")
         print(f"[repeat {t}] wall time {time.time() - t0:.1f}s "

@@ -150,15 +150,22 @@ def resolve_p_guard(p_guard: str = "auto") -> str:
     if p_guard.startswith("clip:"):
         # validate the radius HERE, with the env var named — a bare
         # float() crash later (or a sign-flipping negative radius,
-        # silently) would never mention FEDAMW_P_GUARD
+        # silently) would never mention FEDAMW_P_GUARD. `not (radius >
+        # 0)` rather than `radius <= 0`: both comparisons are False for
+        # NaN, so the latter let 'clip:nan' through to scale p by
+        # NaN/norm — the exact divergence the guard exists to prevent
+        # (ADVICE r5); 'clip:inf' was a silent no-op guard, same fate.
+        import math
+
         try:
             radius = float(p_guard.split(":", 1)[1])
         except ValueError:
             radius = -1.0
-        if radius <= 0:
+        if not (radius > 0) or math.isinf(radius):
             raise ValueError(
                 f"p_guard={p_guard!r} (FEDAMW_P_GUARD): the clip "
-                "radius must be a positive number, e.g. 'clip:2.5'")
+                "radius must be a positive finite number, e.g. "
+                "'clip:2.5'")
     elif p_guard not in ("none", "simplex", "clip"):
         raise ValueError(
             f"p_guard={p_guard!r}; expected 'none', 'simplex', 'clip' "

@@ -1,18 +1,24 @@
 from .mesh import (
+    BATCH_AXIS,
     CLIENT_AXIS,
+    batch_spec,
     client_spec,
     initialize_multihost,
     make_mesh,
+    make_serving_mesh,
     replicated,
     shard_client_keys,
     shard_setup,
 )
 
 __all__ = [
+    "BATCH_AXIS",
     "CLIENT_AXIS",
+    "batch_spec",
     "client_spec",
     "initialize_multihost",
     "make_mesh",
+    "make_serving_mesh",
     "replicated",
     "shard_client_keys",
     "shard_setup",

@@ -32,6 +32,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 CLIENT_AXIS = "clients"
 
+# Serving renames the sharded axis, nothing else: training shards the
+# CLIENT axis of the packed index sets, inference shards the BATCH axis
+# of padded request buckets — same 1-D mesh, same GSPMD placement+jit
+# pattern, same compiled-program-per-shape discipline (serving/engine.py).
+BATCH_AXIS = "batch"
+
 
 def initialize_multihost(coordinator_address: str | None = None,
                          num_processes: int | None = None,
@@ -87,11 +93,25 @@ def make_mesh(n_devices: int | None = None, axis_name: str = CLIENT_AXIS) -> Mes
     return Mesh(np.array(devices), (axis_name,))
 
 
+def make_serving_mesh(n_devices: int | None = None) -> Mesh:
+    """The inference twin of :func:`make_mesh`: a 1-D mesh whose axis is
+    the request-batch axis (``P('batch', None)`` on padded buckets,
+    params replicated — see ``serving/engine.py``)."""
+    return make_mesh(n_devices, axis_name=BATCH_AXIS)
+
+
 def client_spec(mesh: Mesh, ndim: int = 2) -> NamedSharding:
     """Leading-axis client sharding for an ndim-D array."""
     return NamedSharding(
         mesh, P(mesh.axis_names[0], *([None] * (ndim - 1)))
     )
+
+
+def batch_spec(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Leading-axis BATCH sharding for serving inputs — identical
+    placement math to :func:`client_spec`, named for the serving axis
+    so call sites read as what they shard."""
+    return client_spec(mesh, ndim)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -40,3 +40,15 @@ def get_algorithm(name: str, backend: str = "jax"):
     if name not in algos:
         raise ValueError(f"unknown algorithm {name!r}; choose from {sorted(algos)}")
     return algos[name]
+
+
+def get_serving() -> ModuleType:
+    """The inference side of the registry: drivers obtain the serving
+    subsystem the same way they obtain a training backend —
+    ``registry.get_serving().ServingEngine.load(ckpt)`` — keeping the
+    one-registry surface the north star requires. JAX-only: serving is
+    the compiled-predictor path (the torch backend is a CPU parity
+    oracle, not a serving target)."""
+    from . import serving
+
+    return serving

@@ -1,0 +1,34 @@
+"""TPU-native inference serving over trained checkpoints.
+
+The train side of the repo ends at ``utils/checkpoint.py``; this package
+is the serve side: ``engine`` (checkpoint -> one fused jitted predictor,
+bucket-ladder compiled, mesh-replicable), ``batcher`` (dynamic
+micro-batching), ``service`` (stdlib thread+queue request loop with
+deadlines and overload shedding), ``metrics`` (latency percentiles /
+throughput / shed counters). Driven by ``serve_bench.py`` at the repo
+root, which emits ``BENCH_SERVE_*.json`` in the ``bench.py`` schema
+family with the same strict-backend guard.
+"""
+
+from .batcher import MicroBatcher, coalesce, drain, split_results
+from .engine import DEFAULT_BUCKETS, ServingEngine, bucket_for, infer_model
+from .metrics import LatencyHistogram, ServeMetrics
+from .service import (DeadlineExceeded, Overloaded, ServiceStopped,
+                      ServingService)
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "DeadlineExceeded",
+    "LatencyHistogram",
+    "MicroBatcher",
+    "Overloaded",
+    "ServeMetrics",
+    "ServiceStopped",
+    "ServingEngine",
+    "ServingService",
+    "bucket_for",
+    "coalesce",
+    "drain",
+    "infer_model",
+    "split_results",
+]

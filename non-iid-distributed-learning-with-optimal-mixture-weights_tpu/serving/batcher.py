@@ -1,0 +1,105 @@
+"""Dynamic micro-batching: coalesce queued requests into one bucket.
+
+Requests arrive as independent ``(k_i, d)`` (or single-row) arrays; the
+engine wants one padded bucket per XLA dispatch. The split is
+deliberate: :func:`coalesce`/:func:`split_results` are pure functions
+over request lists (trivially testable), :func:`drain` is the queue-side
+accumulation policy (grab what's already waiting, linger at most
+``max_wait`` for stragglers, never exceed the engine's largest bucket),
+and ``service.py`` owns the thread that glues them to a live queue.
+
+The wait bound trades tail latency for batch occupancy exactly like any
+production batcher: under load the queue is never empty so ``drain``
+returns instantly with a full bucket; at low rates a request waits at
+most ``max_wait`` before flying solo in the smallest rung.
+"""
+
+from __future__ import annotations
+
+import queue
+import time
+from typing import Sequence
+
+import numpy as np
+
+
+def request_rows(x: np.ndarray) -> int:
+    """Row count of one request payload (single rows count as 1)."""
+    return 1 if x.ndim == 1 else int(x.shape[0])
+
+
+def coalesce(payloads: Sequence[np.ndarray]) -> tuple[np.ndarray, list]:
+    """Stack request payloads into one ``(sum k_i, d)`` matrix.
+
+    Returns ``(X, spans)`` where ``spans[i] = (lo, hi, single)`` maps
+    request ``i`` back to its output rows (``single`` restores the
+    1-D shape of a bare-row request).
+    """
+    rows, spans, lo = [], [], 0
+    for x in payloads:
+        x = np.asarray(x, dtype=np.float32)
+        single = x.ndim == 1
+        if single:
+            x = x[None, :]
+        rows.append(x)
+        spans.append((lo, lo + x.shape[0], single))
+        lo += x.shape[0]
+    return np.concatenate(rows, axis=0), spans
+
+
+def split_results(out: np.ndarray, spans: list) -> list:
+    """Inverse of :func:`coalesce` over the stacked logits."""
+    return [out[lo] if single else out[lo:hi]
+            for lo, hi, single in spans]
+
+
+def drain(q: "queue.Queue", first, max_rows: int,
+          max_wait: float = 0.002, clock=time.monotonic) -> tuple:
+    """Accumulate a batch starting from ``first``.
+
+    Takes everything already queued, then waits up to ``max_wait``
+    seconds (from now) for more, stopping early once adding the NEXT
+    request would exceed ``max_rows`` — that request is never split (a
+    request is the atomic unit; the engine chunks oversized single
+    requests itself) and is returned as the HOLDOVER, which the caller
+    must seed the next batch with. Returns ``(batch, holdover)`` where
+    ``holdover`` is None when the drain ended on timeout/budget-exact.
+
+    Handing the over-budget request back (rather than re-queueing it at
+    the tail) bounds its extra delay to one batch: at the tail, a large
+    request under a sustained stream of small ones could be bounced
+    behind fresh arrivals indefinitely, until its deadline sheds it.
+    """
+    batch = [first]
+    rows = request_rows(first.x) if hasattr(first, "x") else \
+        request_rows(first)
+    deadline = clock() + max_wait
+    while rows < max_rows:
+        remaining = deadline - clock()
+        try:
+            nxt = q.get_nowait() if remaining <= 0 else q.get(
+                timeout=remaining)
+        except queue.Empty:
+            break
+        n = request_rows(nxt.x) if hasattr(nxt, "x") else \
+            request_rows(nxt)
+        if rows + n > max_rows:
+            return batch, nxt
+        batch.append(nxt)
+        rows += n
+    return batch, None
+
+
+class MicroBatcher:
+    """Convenience wrapper: one engine dispatch for many requests."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def run(self, payloads: Sequence[np.ndarray]) -> list:
+        """Serve all payloads in a single coalesced engine call and
+        hand each request its own logits back."""
+        if not payloads:
+            return []
+        X, spans = coalesce(payloads)
+        return split_results(self.engine.predict(X), spans)

@@ -1,0 +1,267 @@
+"""Checkpoint -> compiled predictor: the serving half of the stack.
+
+Training ends at ``utils/checkpoint.py`` — ``(global_params, p, round)``
+on disk "so a trained model can be reloaded for inference" — and until
+now nothing ever reloaded one. :class:`ServingEngine` closes that loop:
+it restores a checkpoint (orbax or pickle layout, transparently), puts
+the parameter pytree on device ONCE (replicated over a serving mesh when
+one is given), and serves queries through a single jitted end-to-end
+predictor that fuses the RFF feature map (``ops/rff.py`` — the identical
+``rff_map`` expression, inlined under the same jit) with the model head,
+so raw inputs go HBM-in / logits-out in one XLA program.
+
+Shape discipline is the whole latency story: every request batch is
+padded up to a fixed bucket ladder (default ``1/8/64/512/4096`` rows),
+so XLA compiles exactly one program per bucket and a warmed engine
+serves ANY mixed-size request stream with zero recompiles — pinned via
+the jit compile-cache counter (``tests/test_serve_contract.py``). Rows
+are independent through the whole network (matmul/cos/ReLU act row-wise)
+so padding rows are inert; on the same backend the served logits are
+bitwise what ``fedcore/evaluate.py`` computes in-memory, and accuracy
+parity is exact across backends.
+
+Scale-out mirrors training (``parallel/mesh.py``): the GSPMD pattern is
+unchanged, only the sharded axis renames from ``'clients'`` to
+``'batch'`` — padded inputs are placed ``P('batch', None)``, params
+replicated, and the same compiled program runs on 1 chip or a pod slice.
+Buckets are rounded up to a multiple of the mesh size so every shard
+stays shape-static.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import Model, linear_model, mlp_model
+from ..ops.rff import rff_map
+
+#: Default padded-batch ladder. Powers of 8: the step between rungs
+#: bounds padding waste at 8x worst-case (cheap — the workload is
+#: op-overhead-bound, PERFORMANCE.md § MFU) while keeping the number of
+#: compiled programs at 5 for the whole 1..4096-row request range.
+DEFAULT_BUCKETS = (1, 8, 64, 512, 4096)
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest ladder rung holding ``n`` rows.
+
+    Oversized requests are the CALLER's job to chunk (``predict`` does);
+    returning the max bucket here would silently truncate.
+    """
+    if n <= 0:
+        raise ValueError(f"need at least one row, got {n}")
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(
+        f"{n} rows exceeds the largest bucket {buckets[-1]}; "
+        "chunk the request (ServingEngine.predict does this)")
+
+
+def infer_model(params) -> Model:
+    """Reconstruct the zoo member a checkpointed pytree belongs to.
+
+    Checkpoints store parameters only (the reference persists even less
+    — metrics, ``exp.py:132-143``), but the zoo's pytree layouts are
+    self-describing: ``{"w"}`` is the flagship linear model and
+    ``{"w1","b1",...,"wK"}`` an MLP whose hidden widths are the leading
+    dims of the hidden weights. Conv pytrees carry shape state the keys
+    alone don't pin down — pass the Model explicitly for those.
+    """
+    keys = set(params)
+    if keys == {"w"}:
+        return linear_model()
+    depth = sum(1 for k in keys if k.startswith("w"))
+    mlp_keys = {f"w{i}" for i in range(1, depth + 1)} | {
+        f"b{i}" for i in range(1, depth)}
+    if depth >= 2 and keys == mlp_keys:
+        widths = tuple(int(params[f"w{i}"].shape[0])
+                       for i in range(1, depth))
+        return mlp_model(widths[0] if len(widths) == 1 else widths)
+    raise ValueError(
+        f"cannot infer a zoo model from parameter keys {sorted(keys)}; "
+        "pass model=Model(...) explicitly — conv also needs input_dim=d "
+        "(its 'w' head sees post-conv features, so the raw width is "
+        "not inferable from the pytree)")
+
+
+class ServingEngine:
+    """A warmed, bucket-compiled predictor over a trained checkpoint.
+
+    ``predict`` accepts a ``(n, d)`` batch (or a single ``(d,)`` row),
+    pads it to the bucket ladder, runs the one fused XLA program for
+    that bucket, and returns the valid ``(n, C)`` logits. All state —
+    params, the RFF draw — is device-put exactly once at construction;
+    per-call traffic is the padded input alone (donated on TPU, so XLA
+    reuses its buffer).
+    """
+
+    def __init__(self, params, model: Model | str = "auto", rff=None,
+                 buckets: Sequence[int] = DEFAULT_BUCKETS, mesh=None,
+                 feature_dtype=None, input_dim: int | None = None):
+        self.model = infer_model(params) if model == "auto" else model
+        if isinstance(self.model, str):
+            from ..models import get_model
+
+            self.model = get_model(self.model)
+        self.mesh = mesh
+        n_dev = int(mesh.devices.size) if mesh is not None else 1
+        # mesh-even rungs: each shard of a P('batch') input must be
+        # shape-static, so rungs round UP to a device multiple (never
+        # down — a smaller rung would re-introduce recompiles)
+        ladder = sorted({-(-int(b) // n_dev) * n_dev for b in buckets})
+        if not ladder or ladder[0] <= 0:
+            raise ValueError(f"bad bucket ladder {buckets!r}")
+        self.buckets = tuple(ladder)
+
+        params = jax.tree.map(jnp.asarray, params)
+        if rff is not None:
+            rff = (jnp.asarray(np.asarray(rff[0])),
+                   jnp.asarray(np.asarray(rff[1])))
+        if mesh is not None:
+            from ..parallel.mesh import batch_spec, replicated
+
+            rep = replicated(mesh)
+            params = jax.device_put(params, rep)
+            if rff is not None:
+                rff = jax.device_put(rff, rep)
+            self._in_spec = batch_spec(mesh, 2)
+        else:
+            self._in_spec = None
+        self.params = params
+        self.rff = rff
+
+        from ..fedcore.client import _TPU_BACKENDS
+
+        # donating the padded input lets XLA reuse its buffer call to
+        # call; CPU has no donation and would warn once per bucket
+        donate = (0,) if jax.default_backend() in _TPU_BACKENDS else ()
+
+        self.feature_dtype = feature_dtype
+
+        def forward(x, params, rff):
+            if rff is not None:
+                x = rff_map(x, *rff)  # inlined under this jit: one program
+            if feature_dtype is not None:
+                # parity with a narrow-feature training run
+                # (prepare_setup(feature_dtype=...)): after the map on
+                # the fused path (rff_map_to is the same f32 map cast
+                # down), and on pre-mapped inputs directly — the
+                # checkpoint carries no dtype marker, so the operator
+                # passes it here, and it must not be a silent no-op on
+                # either path
+                x = x.astype(feature_dtype)
+            return self.model.apply(params, x)
+
+        self._predict = jax.jit(forward, donate_argnums=donate)
+        self._input_dim = input_dim
+        self._shapes_seen: set = set()  # compile-count fallback basis
+
+    def _weight_keys(self) -> list[str]:
+        # numeric layer order ("w2" before "w10"; bare "w" is layer 0)
+        return sorted((k for k in self.params if k.startswith("w")),
+                      key=lambda k: int(k[1:] or 0))
+
+    @property
+    def input_dim(self) -> int:
+        """Raw feature width a request row must have. Inferred from the
+        RFF draw or the first weight's fan-in; models whose pytree does
+        not start with a dense layer over the raw input (conv: the 'w'
+        head sees post-conv flattened features, not pixels) must pass
+        ``input_dim=d`` explicitly at construction."""
+        if self._input_dim is not None:
+            return self._input_dim
+        if self.rff is not None:
+            return int(self.rff[0].shape[0])
+        return int(self.params[self._weight_keys()[0]].shape[1])
+
+    @property
+    def num_classes(self) -> int:
+        return int(self.params[self._weight_keys()[-1]].shape[0])
+
+    @property
+    def compile_count(self) -> int:
+        """Compiled programs in the predictor's jit cache — stable at
+        ``len(self.buckets)`` after :meth:`warmup`, the zero-recompile
+        invariant the serve bench certifies.
+
+        Read from the jit cache counter when available (private API,
+        exact); on a jax without it, the count of distinct padded input
+        shapes dispatched — an honest equal proxy, since one shape is
+        one compiled program under a fixed jit."""
+        try:
+            return int(self._predict._cache_size())
+        except AttributeError:
+            return len(self._shapes_seen)
+
+    @classmethod
+    def load(cls, path: str, model: Model | str = "auto",
+             buckets: Sequence[int] = DEFAULT_BUCKETS, mesh=None,
+             rff=None, feature_dtype=None,
+             input_dim: int | None = None) -> "ServingEngine":
+        """Restore a ``save_checkpoint`` directory (either layout) into
+        a ready engine. A checkpoint saved with ``rff=setup.rff``
+        carries its feature-map draw (``rff_W``/``rff_b``) and the
+        engine serves RAW inputs; otherwise it serves pre-mapped
+        features (or pass ``rff=(W, b)`` explicitly). For a run trained
+        with ``prepare_setup(feature_dtype=...)`` pass the same dtype
+        here — the checkpoint does not record it."""
+        from ..utils.checkpoint import load_checkpoint
+
+        state = load_checkpoint(path)
+        if rff is None and "rff_W" in state and "rff_b" in state:
+            rff = (state["rff_W"], state["rff_b"])
+        if feature_dtype is None and "feature_dtype" in state:
+            # the checkpoint's own marker (save_checkpoint(
+            # feature_dtype=...)) — an explicit argument still wins
+            feature_dtype = str(state["feature_dtype"])
+        return cls(state["params"], model=model, rff=rff,
+                   buckets=buckets, mesh=mesh,
+                   feature_dtype=feature_dtype, input_dim=input_dim)
+
+    def _run(self, X: np.ndarray) -> np.ndarray:
+        n, d = X.shape
+        b = bucket_for(n, self.buckets)
+        if n < b:
+            X = np.concatenate(
+                [X, np.zeros((b - n, d), X.dtype)], axis=0)
+        # one transfer: the numpy batch is sharded host-side straight
+        # to the batch spec (an intermediate jnp.asarray would commit
+        # it to the default device first, a second full copy per call)
+        x = (jnp.asarray(X) if self._in_spec is None
+             else jax.device_put(X, self._in_spec))
+        self._shapes_seen.add(X.shape)
+        out = self._predict(x, self.params, self.rff)
+        # np.asarray blocks until ready — predict latency is honest
+        return np.asarray(out)[:n]
+
+    def predict(self, X) -> np.ndarray:
+        """Logits for a ``(n, d)`` batch or ``(d,)`` row; any ``n`` —
+        oversized batches are served in max-bucket chunks."""
+        X = np.asarray(X, dtype=np.float32)
+        single = X.ndim == 1
+        if single:
+            X = X[None, :]
+        if X.ndim != 2 or X.shape[1] != self.input_dim:
+            raise ValueError(
+                f"expected (n, {self.input_dim}) rows, got {X.shape}")
+        top = self.buckets[-1]
+        if X.shape[0] <= top:
+            out = self._run(X)
+        else:
+            out = np.concatenate(
+                [self._run(X[lo:lo + top])
+                 for lo in range(0, X.shape[0], top)], axis=0)
+        return out[0] if single else out
+
+    def warmup(self) -> int:
+        """Compile every bucket (zeros input); returns the compile
+        count, after which a mixed-size stream triggers none."""
+        d = self.input_dim
+        for b in self.buckets:
+            self._run(np.zeros((b, d), np.float32))
+        return self.compile_count

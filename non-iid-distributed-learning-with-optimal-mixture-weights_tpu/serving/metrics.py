@@ -1,0 +1,137 @@
+"""Serving observability: latency percentiles, throughput, shed counts.
+
+Stdlib-only (a serving box must not grow runtime deps for its gauges).
+The histogram keeps raw samples up to a bound and computes percentiles
+by sorting at snapshot time — exact, and at serving-bench scale (1e4-1e5
+samples) far cheaper than maintaining quantile sketches. Past the bound
+it degrades to uniform reservoir sampling, so long-running services keep
+statistically honest tails instead of silently dropping the newest data.
+
+``snapshot()`` emits the ``BENCH_SERVE_*`` field family the driver
+parses (``serve_bench.py``), same schema discipline as ``bench.py``.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+
+class LatencyHistogram:
+    """Exact-percentile latency recorder with reservoir degradation."""
+
+    def __init__(self, max_samples: int = 100_000):
+        self.max_samples = max_samples
+        self._samples: list[float] = []
+        self._seen = 0
+        self._rng = random.Random(0)  # deterministic reservoir
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._seen += 1
+            if len(self._samples) < self.max_samples:
+                self._samples.append(seconds)
+            else:
+                j = self._rng.randrange(self._seen)
+                if j < self.max_samples:
+                    self._samples[j] = seconds
+
+    @property
+    def count(self) -> int:
+        return self._seen
+
+    def percentiles(self, qs=(50, 95, 99)) -> dict[str, float]:
+        """``{"p50_ms": ..., ...}`` — nearest-rank, in milliseconds."""
+        with self._lock:
+            data = sorted(self._samples)
+        out = {}
+        for q in qs:
+            if not data:
+                out[f"p{q}_ms"] = None
+                continue
+            idx = min(len(data) - 1, max(0, -(-q * len(data) // 100) - 1))
+            out[f"p{q}_ms"] = round(data[idx] * 1e3, 4)
+        return out
+
+
+class ServeMetrics:
+    """One bundle of everything the serve bench and contract tests
+    assert on: request latency, rows/requests served, shedding, queue
+    pressure, and (via the engine) the compile-cache counter."""
+
+    def __init__(self):
+        self.latency = LatencyHistogram()
+        self._lock = threading.Lock()
+        self.requests_served = 0
+        self.rows_served = 0
+        self.batches = 0
+        self.shed_deadline = 0
+        self.shed_overload = 0
+        self.shed_shutdown = 0
+        self.queue_depth_peak = 0
+        self._t_first = None
+        self._t_last = None
+
+    def observe_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            if depth > self.queue_depth_peak:
+                self.queue_depth_peak = depth
+
+    def record_shed(self, reason: str) -> None:
+        """``reason``: 'deadline' (request expired while queued),
+        'overload' (rejected at the door), or 'shutdown' (backlog
+        dropped by a non-draining stop) — separable signals: an
+        operator alerting on deadline violations must not page on a
+        deliberate shutdown."""
+        with self._lock:
+            if reason == "deadline":
+                self.shed_deadline += 1
+            elif reason == "shutdown":
+                self.shed_shutdown += 1
+            else:
+                self.shed_overload += 1
+
+    def record_batch(self, n_requests: int, n_rows: int,
+                     latencies: list[float],
+                     now: float | None = None) -> None:
+        now = time.perf_counter() if now is None else now
+        with self._lock:
+            self.batches += 1
+            self.requests_served += n_requests
+            self.rows_served += n_rows
+            if self._t_first is None:
+                self._t_first = now
+            self._t_last = now
+        for s in latencies:
+            self.latency.record(s)
+
+    def snapshot(self, engine=None) -> dict:
+        with self._lock:
+            elapsed = ((self._t_last - self._t_first)
+                       if self._t_first is not None
+                       and self._t_last is not None
+                       and self._t_last > self._t_first else None)
+            snap = {
+                "requests": self.requests_served,
+                "rows": self.rows_served,
+                "batches": self.batches,
+                "shed_deadline": self.shed_deadline,
+                "shed_overload": self.shed_overload,
+                "shed_shutdown": self.shed_shutdown,
+                "queue_depth_peak": self.queue_depth_peak,
+                "mean_batch_rows": (
+                    round(self.rows_served / self.batches, 2)
+                    if self.batches else None),
+                "throughput_req_per_s": (
+                    round(self.requests_served / elapsed, 2)
+                    if elapsed else None),
+                "throughput_rows_per_s": (
+                    round(self.rows_served / elapsed, 2)
+                    if elapsed else None),
+            }
+        snap.update(self.latency.percentiles())
+        if engine is not None:
+            snap["compile_count"] = engine.compile_count
+        return snap

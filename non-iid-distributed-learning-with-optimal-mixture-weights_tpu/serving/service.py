@@ -1,0 +1,291 @@
+"""Request loop: a thread + queue front over the batched engine.
+
+Deliberately stdlib-only (``threading``/``queue``/``concurrent.futures``
+— no server framework; the container adds no runtime deps and a real
+deployment would front this with whatever RPC layer it already has).
+The loop is the standard dynamic-batching serving shape:
+
+  submit() -> bounded queue -> worker drains a micro-batch
+  (batcher.drain) -> expired requests shed -> one engine dispatch ->
+  per-request futures resolved.
+
+Overload policy is shed-at-the-door: when the queue holds ``max_queue``
+requests, ``submit`` fails IMMEDIATELY with :class:`Overloaded` instead
+of queueing work that would only time out later — bounded queue depth is
+what keeps p99 bounded under a load spike. Per-request deadlines are
+enforced at dequeue: a request that waited past its deadline is resolved
+with :class:`DeadlineExceeded` and never spends engine time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+
+import numpy as np
+
+from .batcher import coalesce, drain, request_rows, split_results
+from .metrics import ServeMetrics
+
+
+class Overloaded(RuntimeError):
+    """Queue at capacity; request shed before enqueue."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """Request expired while queued; never reached the engine."""
+
+
+class ServiceStopped(RuntimeError):
+    """Backlog request dropped by a non-draining shutdown — distinct
+    from :class:`DeadlineExceeded` so a caller retrying timeouts with a
+    longer deadline does not misread a deliberate stop as one."""
+
+
+def _resolve(fut: Future, result=None, exc=None) -> None:
+    """Resolve a request Future, tolerating caller-side cancellation:
+    ``set_result``/``set_exception`` on a cancelled Future raise
+    ``InvalidStateError``, and letting that escape would kill the
+    worker thread and strand every other queued request forever."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(result)
+    except InvalidStateError:
+        pass
+
+
+@dataclasses.dataclass
+class _Request:
+    x: np.ndarray
+    future: Future
+    t_submit: float
+    deadline: float | None  # absolute perf_counter time, or None
+
+
+class ServingService:
+    """Thread-per-engine serving loop with dynamic micro-batching.
+
+    Use as a context manager (or ``start()``/``stop()``). ``submit``
+    is thread-safe and non-blocking: it returns a
+    ``concurrent.futures.Future`` resolving to the request's logits.
+    """
+
+    def __init__(self, engine, max_queue: int = 1024,
+                 max_wait_ms: float = 2.0, metrics: ServeMetrics | None = None):
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        self.max_wait = max_wait_ms / 1e3
+        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self._width = engine.input_dim  # computed once, checked per submit
+        self._q: queue.Queue[_Request] = queue.Queue()
+        # accepted-but-unserved request count, mutated under the lock:
+        # a bare qsize()-then-put check is a race (N concurrent submits
+        # could all pass it and blow the bound exactly during the load
+        # spike it exists for), and Queue(maxsize=...) would make the
+        # batcher's drain() put-back block against full-queue pressure
+        self._depth = 0
+        self._depth_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> "ServingService":
+        if self._thread is not None:
+            raise RuntimeError("service already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._worker,
+                                        name="serve-worker", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain_queue: bool = True) -> None:
+        """Graceful stop: by default the worker finishes everything
+        already queued before exiting (accepted work is served);
+        ``drain_queue=False`` sheds the backlog with
+        :class:`ServiceStopped` instead.
+
+        Setting the stop flag makes ``submit`` refuse new work, so the
+        worker's drain terminates; a submit that raced past the flag
+        check is caught by the post-join sweep — no Future is ever
+        stranded by a shutdown."""
+        if self._thread is None:
+            return
+        if not drain_queue:
+            while True:
+                try:
+                    req = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                with self._depth_lock:
+                    self._depth -= 1
+                self.metrics.record_shed("shutdown")
+                _resolve(req.future,
+                         exc=ServiceStopped("service stopping"))
+        with self._depth_lock:
+            # same lock as submit's check-and-put: see the atomicity
+            # comment there
+            self._stop.set()
+        self._thread.join()
+        self._thread = None
+        self._sweep_leftovers(drain_queue)
+
+    def _sweep_leftovers(self, drain_queue: bool) -> None:
+        """Resolve requests the worker never saw — a ``submit`` that
+        passed the liveness check concurrently with ``stop`` lands its
+        request after the worker exited; served (or shed) here, its
+        Future resolves instead of hanging a caller forever."""
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                return
+            with self._depth_lock:
+                self._depth -= 1
+            expired = (req.deadline is not None
+                       and time.perf_counter() > req.deadline)
+            if expired:
+                # the sweep honors deadlines exactly like the worker's
+                # dequeue check — a stop() race must not turn an
+                # already-expired request into a late success
+                self.metrics.record_shed("deadline")
+                _resolve(req.future,
+                         exc=DeadlineExceeded("expired while queued"))
+                continue
+            if not drain_queue:
+                self.metrics.record_shed("shutdown")
+                _resolve(req.future,
+                         exc=ServiceStopped("service stopped"))
+                continue
+            try:
+                out = self.engine.predict(req.x)
+            except Exception as e:
+                _resolve(req.future, exc=e)
+                continue
+            done = time.perf_counter()
+            # same accounting as the worker path: served is served,
+            # whichever thread resolved it — and metrics before the
+            # future, so a caller's post-result snapshot counts it
+            self.metrics.record_batch(
+                n_requests=1, n_rows=request_rows(req.x),
+                latencies=[done - req.t_submit], now=done)
+            _resolve(req.future, result=out)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- request side -------------------------------------------------
+    def submit(self, x, timeout_s: float | None = None) -> Future:
+        """Enqueue one request; sheds immediately when over capacity."""
+        if self._thread is None:
+            raise RuntimeError("service not started")
+        x = np.asarray(x, dtype=np.float32)
+        if (x.ndim not in (1, 2) or x.shape[-1] != self._width
+                or x.shape[0] == 0):
+            # reject malformed payloads HERE, in the caller's thread —
+            # queued, they could only fail inside the worker, where a
+            # width mismatch would poison the whole coalesced batch
+            # (failing OTHER callers' valid requests alongside), and a
+            # zero-row batch would succeed or fail depending on what
+            # it happened to be coalesced with
+            raise ValueError(
+                f"request must be a ({self._width},) row or a non-empty "
+                f"(n, {self._width}) batch, got shape {x.shape}")
+        now = time.perf_counter()
+        fut: Future = Future()
+        req = _Request(
+            x=x, future=fut, t_submit=now,
+            deadline=None if timeout_s is None else now + timeout_s)
+        with self._depth_lock:
+            # stop-check and enqueue are ATOMIC under the lock: stop()
+            # flips the flag under the same lock, so a put either
+            # happens-before the flag (the worker/post-join sweep will
+            # see it) or the submit observes the flag and refuses —
+            # there is no window for a request to land after the sweep
+            if self._stop.is_set():
+                # typed so failover logic can tell a deliberate stop
+                # from an unexpected server error (ServiceStopped IS a
+                # RuntimeError, so broad handlers still work)
+                raise ServiceStopped("service stopping")
+            depth = self._depth
+            if depth >= self.max_queue:
+                shed = True
+            else:
+                shed = False
+                self._depth += 1
+                depth = self._depth
+                self._q.put(req)
+        if shed:
+            self.metrics.record_shed("overload")
+            raise Overloaded(
+                f"queue depth {depth} at capacity "
+                f"(max_queue={self.max_queue})")
+        self.metrics.observe_queue_depth(depth)
+        return fut
+
+    def predict(self, x, timeout_s: float | None = None):
+        """Blocking convenience: submit and wait."""
+        return self.submit(x, timeout_s=timeout_s).result()
+
+    # -- worker side --------------------------------------------------
+    def _worker(self) -> None:
+        max_rows = self.engine.buckets[-1]
+        held: _Request | None = None  # drain's over-budget holdover —
+        # it seeds the NEXT batch, so a large request's extra delay is
+        # bounded to one batch instead of starving behind fresh arrivals
+        while True:
+            if held is not None:
+                first, held = held, None
+            else:
+                try:
+                    first = self._q.get(timeout=0.02)
+                except queue.Empty:
+                    if self._stop.is_set():
+                        return
+                    continue
+            batch, held = drain(self._q, first, max_rows,
+                                max_wait=0.0 if self._stop.is_set()
+                                else self.max_wait)
+            with self._depth_lock:
+                # these requests left the queue for good (the holdover
+                # stays accounted until its own batch serves it)
+                self._depth -= len(batch)
+            now = time.perf_counter()
+            live = []
+            for req in batch:
+                if req.deadline is not None and now > req.deadline:
+                    self.metrics.record_shed("deadline")
+                    _resolve(req.future, exc=DeadlineExceeded(
+                        f"queued {now - req.t_submit:.4f}s, past the "
+                        "request deadline"))
+                else:
+                    live.append(req)
+            if not live:
+                continue
+            try:
+                # coalesce INSIDE the guard: mixed feature widths in
+                # one micro-batch raise here, and an escape would kill
+                # the worker thread and strand every queued future
+                X, spans = coalesce([r.x for r in live])
+                outs = split_results(self.engine.predict(X), spans)
+            except Exception as e:  # batch failure -> every caller told
+                for req in live:
+                    _resolve(req.future, exc=e)
+                continue
+            done = time.perf_counter()
+            # metrics BEFORE resolving futures: a caller that waits on
+            # its future and then snapshots must see this batch counted
+            self.metrics.record_batch(
+                n_requests=len(live),
+                n_rows=sum(request_rows(r.x) for r in live),
+                latencies=[done - r.t_submit for r in live],
+                now=done)
+            for req, out in zip(live, outs):
+                _resolve(req.future, result=out)

@@ -31,27 +31,72 @@ def _to_host(tree):
 
 
 def save_checkpoint(path: str, params, p=None, round_idx: int | None = None,
-                    extra: dict | None = None) -> str:
+                    extra: dict | None = None, rff=None,
+                    feature_dtype=None) -> str:
     """Save algorithm state under ``path`` (a directory). Returns the
-    path actually written."""
+    path actually written.
+
+    ``rff`` is the setup's ``(W, b)`` feature-map draw. Model params
+    alone can only score PRE-MAPPED features; the draw is what makes the
+    checkpoint self-contained for serving raw inputs
+    (``serving.ServingEngine.load`` fuses it into the predictor).
+    ``feature_dtype`` marks a narrow-feature training run
+    (``prepare_setup(feature_dtype=...)``): without the marker, serving
+    would silently score float32 features against a head trained on
+    narrow ones.
+    """
     state: dict[str, Any] = {"params": _to_host(params)}
     if p is not None:
         state["p"] = np.asarray(p)
     if round_idx is not None:
         state["round"] = int(round_idx)
+    if rff is not None:
+        state["rff_W"] = np.asarray(rff[0])
+        state["rff_b"] = np.asarray(rff[1])
+    if feature_dtype is not None:
+        # stored as the canonical name string ('bfloat16' — np.dtype
+        # resolves numpy/jax scalar types, dtype objects, and names);
+        # the serving side feeds it back through astype
+        state["feature_dtype"] = str(np.dtype(feature_dtype))
     if extra:
         # e.g. optimizer-state leaf tuples ('p_opt'/'server_opt' from
         # return_state=True) — host-convert like params
         state.update({k: _to_host(v) for k, v in extra.items()})
     os.makedirs(path, exist_ok=True)
+    # Each save leaves exactly ONE layout under `path`: load_checkpoint
+    # prefers an orbax dir over state.pkl, so a layout left behind by an
+    # EARLIER save (orbax then, pickle now — or a partial orbax tree
+    # from an interrupted attempt) would silently shadow the fresh
+    # state. Serving makes that load-bearing: a stale shadowed
+    # checkpoint means wrong params (or a missing rff draw) served with
+    # no error.
     try:
         import orbax.checkpoint as ocp
 
         ckpt = os.path.join(os.path.abspath(path), "orbax")
         with ocp.PyTreeCheckpointer() as ckptr:
             ckptr.save(ckpt, state, force=True)
+        try:
+            os.remove(os.path.join(path, "state.pkl"))
+        except OSError:
+            pass
         return ckpt
     except Exception:
+        import shutil
+
+        # stale-orbax removal BEFORE the pickle lands: load_checkpoint
+        # prefers an orbax dir, so (a) if the removal fails this save
+        # fails loudly instead of looking successful while shadowed,
+        # and (b) a crash between the two steps leaves NO checkpoint
+        # (loud FileNotFoundError on load) rather than the stale one
+        # silently serving the earlier round's params
+        stale = os.path.join(os.path.abspath(path), "orbax")
+        shutil.rmtree(stale, ignore_errors=True)
+        if os.path.isdir(stale):
+            raise RuntimeError(
+                f"stale orbax layout at {stale} could not be removed "
+                "and would shadow the pickle fallback on load; remove "
+                "it manually")
         out = os.path.join(path, "state.pkl")
         with open(out, "wb") as f:
             pickle.dump(state, f)

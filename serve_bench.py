@@ -1,0 +1,280 @@
+"""Serving benchmark: checkpoint -> warmed engine -> load generator.
+
+The serve-side sibling of ``bench.py``: it trains a small FedAvg model
+(or loads SERVE_CKPT), saves it through ``utils/checkpoint.py`` WITH the
+RFF draw, restores it via ``serving.ServingEngine.load`` — the full
+production path, not an in-memory shortcut — and measures:
+
+1. **Parity** (abort on failure): engine logits on the raw test set
+   must reproduce ``fedcore/evaluate.py``'s accuracy exactly. A serving
+   stack that serves different numbers than training evaluated is wrong
+   before it is slow.
+2. **Per-bucket latency**: p50/p95/p99 and rows/s for every rung of the
+   bucket ladder, timed at the engine (no queueing).
+3. **Mixed-size stream**: a deterministic request-size mix driven
+   through the full ServingService (queue + micro-batcher + deadlines),
+   reporting request-level percentiles, throughput, shed counts, and —
+   the shape-discipline invariant — **zero recompiles after warmup**,
+   read from the jit compile-cache counter.
+
+Output follows the ``bench.py`` driver contract: JSON lines on stdout
+with the headline metric LAST, plus a ``BENCH_SERVE_rNN.json`` artifact
+(SERVE_OUT overrides the path). The same strict-backend guard applies:
+under BENCH_STRICT_TPU=1 a resolved non-TPU backend aborts rc=1 before
+measuring anything, so a leaked JAX_PLATFORMS=cpu can never be
+harvested green (mirrors bench.py; pinned in
+``tests/test_serve_contract.py``).
+
+Env knobs: SERVE_BUCKETS ("1,8,64,512"), SERVE_D (RFF width, 256),
+SERVE_N (train rows, 4096), SERVE_CLIENTS (8), SERVE_TRAIN_ROUNDS (2),
+SERVE_ITERS (per-bucket timed calls, 30), SERVE_REQUESTS (mixed-stream
+requests, 200), SERVE_MAX_WAIT_MS (2.0), SERVE_CKPT (serve an existing
+checkpoint dir instead of training), SERVE_OUT, SERVE_ROUND (artifact
+suffix, default 1).
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def _env_int(name, default):
+    return int(os.environ.get(name, str(default)))
+
+
+def build_checkpoint(ckpt_dir: str, D: int, n: int, clients: int,
+                     rounds: int):
+    """Train a small FedAvg model on shape-matched synthetic data and
+    checkpoint it (params + mixture weights + RFF draw). Returns the
+    setup (for the parity cross-check) and the raw test matrix."""
+    from fedamw_tpu.algorithms import FedAvg, prepare_setup
+    from fedamw_tpu.data import FederatedDataset, dirichlet_partition
+    from fedamw_tpu.data.synthetic import synthetic_classification
+    from fedamw_tpu.utils.checkpoint import save_checkpoint
+
+    X, y, Xt, yt = synthetic_classification(n, 64, 2, seed=3)
+    parts, _ = dirichlet_partition(y, clients, alpha=0.5, seed=2020,
+                                   min_size=0)
+    ds = FederatedDataset(
+        name="serve-synth", task_type="classification", num_classes=2,
+        d=64, X_train=X, y_train=y, X_test=Xt, y_test=yt, parts=parts,
+        source="synthetic")
+    setup = prepare_setup(ds, D=D, kernel_par=0.1, seed=100,
+                          rng=np.random.RandomState(100))
+    res = FedAvg(setup, lr=0.5, epoch=1, batch_size=32, round=rounds,
+                 seed=0, lr_mode="constant", return_state=True)
+    save_checkpoint(ckpt_dir, res["params"], p=res["p"],
+                    round_idx=rounds, rff=setup.rff)
+    return setup, np.asarray(Xt, np.float32)
+
+
+def check_parity(engine, setup, X_test_raw) -> dict:
+    """Engine-vs-evaluate accuracy on the SAME test set: the serving
+    path re-maps raw inputs through the checkpointed RFF draw, so an
+    exact accuracy match certifies the whole load/fuse/pad pipeline."""
+    import jax.numpy as jnp
+
+    from fedamw_tpu.fedcore import make_evaluator
+
+    evaluate = make_evaluator(setup.model.apply, setup.task)
+    _, eval_acc = evaluate(
+        {k: jnp.asarray(v) for k, v in engine.params.items()},
+        setup.X_test, setup.y_test)
+    logits = engine.predict(X_test_raw)
+    y = np.asarray(setup.y_test)
+    engine_acc = 100.0 * float(np.mean(np.argmax(logits, -1) == y))
+    return {"engine_acc": round(engine_acc, 6),
+            "evaluate_acc": round(float(eval_acc), 6),
+            "match": abs(engine_acc - float(eval_acc)) < 1e-4}
+
+
+def time_bucket(engine, b: int, iters: int, rng) -> dict:
+    """Steady-state latency of one ladder rung (exact-fit batches, so
+    the number is the compiled program + host roundtrip, no padding)."""
+    from fedamw_tpu.serving import LatencyHistogram
+
+    X = rng.randn(b, engine.input_dim).astype(np.float32)
+    hist = LatencyHistogram()
+    engine.predict(X)  # rung already compiled by warmup; absorb cache hits
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        t1 = time.perf_counter()
+        engine.predict(X)
+        hist.record(time.perf_counter() - t1)
+    dt = time.perf_counter() - t0
+    out = hist.percentiles()
+    out.update(iters=iters,
+               throughput_rows_per_s=round(b * iters / dt, 2))
+    return out
+
+
+def mixed_stream(engine, n_requests: int, max_wait_ms: float, rng) -> dict:
+    """Drive a deterministic mixed-size request stream through the full
+    service loop and snapshot its metrics. Sizes mix single rows with
+    every rung boundary's neighborhood so each compiled bucket serves
+    real (non-warmup) traffic."""
+    from fedamw_tpu.serving import ServingService
+
+    sizes = []
+    for b in engine.buckets:
+        sizes += [1, max(1, b // 2), b]
+    sizes = [sizes[i % len(sizes)] for i in rng.permutation(
+        max(n_requests, len(sizes)))[:n_requests]]
+    payloads = [rng.randn(s, engine.input_dim).astype(np.float32)
+                for s in sizes]
+    t0 = time.perf_counter()
+    # the load generator enqueues far faster than the engine drains;
+    # max_queue must admit the whole configured stream or a large
+    # SERVE_REQUESTS would crash with Overloaded instead of measuring
+    with ServingService(engine, max_wait_ms=max_wait_ms,
+                        max_queue=max(1024, n_requests)) as svc:
+        futures = [svc.submit(x) for x in payloads]
+        for f in futures:
+            f.result(timeout=300)
+        dt = time.perf_counter() - t0
+        snap = svc.metrics.snapshot(engine)
+    # end-to-end wall-clock throughput (the metrics-internal rate spans
+    # batch completions only and is None for a single-batch stream)
+    snap["throughput_req_per_s"] = round(len(payloads) / dt, 2)
+    snap["throughput_rows_per_s"] = round(sum(sizes) / dt, 2)
+    return snap
+
+
+def main():
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms:
+        # same dance as bench.py: the container's sitecustomize
+        # force-registers the axon TPU plugin, so the env var must be
+        # re-applied to the config before the first backend query
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
+    import jax
+
+    platform = jax.default_backend()
+    if os.environ.get("BENCH_STRICT_TPU"):
+        from fedamw_tpu.fedcore.client import _TPU_BACKENDS
+
+        if platform not in _TPU_BACKENDS:
+            print(f"# serve_bench aborted: BENCH_STRICT_TPU set but the "
+                  f"resolved backend is {platform!r}", file=sys.stderr)
+            raise SystemExit(1)
+
+    from fedamw_tpu.serving import ServingEngine
+
+    buckets = tuple(int(b) for b in os.environ.get(
+        "SERVE_BUCKETS", "1,8,64,512").split(","))
+    D = _env_int("SERVE_D", 256)
+    iters = _env_int("SERVE_ITERS", 30)
+    n_requests = _env_int("SERVE_REQUESTS", 200)
+    max_wait_ms = float(os.environ.get("SERVE_MAX_WAIT_MS", "2.0"))
+
+    ckpt = os.environ.get("SERVE_CKPT")
+    setup = None
+    scratch = None  # our own train-and-serve checkpoint, removed on exit
+    if ckpt:
+        engine = ServingEngine.load(ckpt, buckets=buckets)
+        print(f"# serving existing checkpoint {ckpt}", file=sys.stderr)
+    else:
+        ckpt = scratch = tempfile.mkdtemp(prefix="serve_ckpt_")
+        setup, X_test_raw = build_checkpoint(
+            ckpt, D=D, n=_env_int("SERVE_N", 4096),
+            clients=_env_int("SERVE_CLIENTS", 8),
+            rounds=_env_int("SERVE_TRAIN_ROUNDS", 2))
+        engine = ServingEngine.load(ckpt, buckets=buckets)
+    try:
+        _run_bench(engine, setup, X_test_raw if setup is not None
+                   else None, ckpt, platform, iters, n_requests,
+                   max_wait_ms)
+    finally:
+        if scratch is not None:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+
+def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
+               n_requests, max_wait_ms):
+
+    parity = None
+    if setup is not None:
+        parity = check_parity(engine, setup, X_test_raw)
+        print(f"# parity: engine {parity['engine_acc']:.4f} vs "
+              f"evaluate {parity['evaluate_acc']:.4f}", file=sys.stderr)
+        if not parity["match"]:
+            # a serving stack that disagrees with training evaluation
+            # must never emit green-looking latency numbers
+            print("# serve_bench aborted: serving/evaluate accuracy "
+                  "parity FAILED", file=sys.stderr)
+            raise SystemExit(1)
+
+    t0 = time.perf_counter()
+    warm_compiles = engine.warmup()
+    warmup_s = time.perf_counter() - t0
+    print(f"# warmup: {warm_compiles} programs "
+          f"({len(engine.buckets)} buckets) in {warmup_s:.2f}s",
+          file=sys.stderr)
+
+    rng = np.random.RandomState(0)
+    bucket_latency = {}
+    for b in engine.buckets:
+        bucket_latency[str(b)] = rec = time_bucket(engine, b, iters, rng)
+        print(json.dumps({
+            "metric": "serve_bucket_latency",
+            "bucket": b, "platform": platform, **rec}))
+        print(f"# bucket {b:>5}: p50 {rec['p50_ms']}ms  p99 "
+              f"{rec['p99_ms']}ms  {rec['throughput_rows_per_s']} rows/s",
+              file=sys.stderr)
+
+    stream = mixed_stream(engine, n_requests, max_wait_ms, rng)
+    recompiles = engine.compile_count - warm_compiles
+    print(f"# mixed stream: {stream['requests']} requests in "
+          f"{stream['batches']} batches, p50 {stream['p50_ms']}ms, "
+          f"recompiles after warmup: {recompiles}", file=sys.stderr)
+
+    artifact = {
+        "metric": "serve_bench",
+        "schema": "BENCH_SERVE.v1",
+        "platform": platform,
+        "engine": {
+            "buckets": list(engine.buckets),
+            "input_dim": engine.input_dim,
+            "num_classes": engine.num_classes,
+            "rff_fused": engine.rff is not None,
+            "checkpoint": ckpt,
+        },
+        "warmup": {"compile_count": warm_compiles,
+                   "seconds": round(warmup_s, 3)},
+        "bucket_latency": bucket_latency,
+        "mixed_stream": stream,
+        "recompiles_after_warmup": recompiles,
+        "parity": parity,
+    }
+    out_path = os.environ.get("SERVE_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"BENCH_SERVE_r{_env_int('SERVE_ROUND', 1):02d}.json")
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"# artifact -> {out_path}", file=sys.stderr)
+
+    # headline LAST (driver contract, as in bench.py): request
+    # throughput through the full service path, tails attached
+    print(json.dumps({
+        "metric": "serve_requests_per_sec",
+        "value": stream["throughput_req_per_s"],
+        "unit": "requests/s",
+        "p50_ms": stream["p50_ms"],
+        "p95_ms": stream["p95_ms"],
+        "p99_ms": stream["p99_ms"],
+        "recompiles_after_warmup": recompiles,
+        "buckets": len(engine.buckets),
+        "platform": platform,
+        "artifact": out_path,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -49,6 +49,29 @@ def test_return_state_matches_reported_metrics(tmp_path):
     np.testing.assert_allclose(float(ta2), float(ta), atol=1e-5)
 
 
+def test_layout_switch_never_shadows_fresh_state(tmp_path, monkeypatch):
+    """An orbax save followed by a pickle-fallback save to the SAME dir
+    (orbax broken on the rerun) must load the FRESH state: the stale
+    orbax layout is removed, not left to shadow the pickle — serving
+    would otherwise restore the old round's params with no error."""
+    import sys
+
+    from fedamw_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+    old = {"w": np.zeros((2, 3), np.float32)}
+    new = {"w": np.ones((2, 3), np.float32)}
+    where1 = save_checkpoint(str(tmp_path / "ck"), old)
+    assert "orbax" in where1  # precondition: first save took orbax
+    monkeypatch.setitem(sys.modules, "orbax", None)
+    monkeypatch.setitem(sys.modules, "orbax.checkpoint", None)
+    where2 = save_checkpoint(str(tmp_path / "ck"), new)
+    assert "state.pkl" in where2
+    monkeypatch.undo()  # load with orbax importable again
+    state = load_checkpoint(str(tmp_path / "ck"))
+    np.testing.assert_array_equal(np.asarray(state["params"]["w"]),
+                                  new["w"])
+
+
 def test_fedamw_returns_learned_p():
     ds = load_dataset("digits", num_partitions=6, alpha=0.5)
     setup = prepare_setup(ds, kernel_type="linear", seed=3,

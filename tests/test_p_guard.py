@@ -42,6 +42,20 @@ def test_resolve_p_guard(monkeypatch):
             resolve_p_guard(bad)
 
 
+def test_resolve_p_guard_rejects_non_finite_radius():
+    """ADVICE r5 regression: `radius <= 0` is False for NaN, so
+    'clip:nan' used to pass validation and the guard multiplied every
+    mixture weight by NaN — the exact divergence the guard exists to
+    prevent. 'clip:inf' was a silent no-op guard. Both must fail
+    loudly, naming the env var; float-parseable spellings included."""
+    for bad in ("clip:nan", "clip:NaN", "clip:inf", "clip:Inf",
+                "clip:-inf", "clip:infinity"):
+        with pytest.raises(ValueError, match="FEDAMW_P_GUARD"):
+            resolve_p_guard(bad)
+    # the fix must not over-reject: ordinary finite radii still resolve
+    assert resolve_p_guard("clip:1e-3") == "clip:1e-3"
+
+
 def test_guard_refuses_pallas_kernel(monkeypatch):
     """An active guard + an explicit Pallas p-solver pin must refuse
     loudly: the fused kernel implements the unconstrained reference

@@ -1,0 +1,86 @@
+"""The driver contract of serve_bench.py (mirror of
+test_bench_contract.py for the serving side).
+
+Pins: JSON lines on stdout with the headline LAST; a BENCH_SERVE
+artifact with per-bucket p50/p95/p99 + throughput for >= 3 rungs;
+ZERO recompiles after warmup across the mixed-size stream (the
+bucket-ladder shape discipline, read from the jit compile-cache
+counter); exact serving/evaluate accuracy parity; and the strict-
+backend guard — BENCH_STRICT_TPU must abort rc=1 on a leaked CPU
+backend BEFORE measuring anything, exactly like bench.py, so a CPU
+capture can never be harvested as TPU evidence.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SMALL = dict(
+    SERVE_BUCKETS="1,8,32", SERVE_D="64", SERVE_N="1024",
+    SERVE_TRAIN_ROUNDS="1", SERVE_ITERS="5", SERVE_REQUESTS="40",
+)
+
+
+def test_serve_bench_emits_driver_contract_json(tmp_path):
+    out_path = str(tmp_path / "BENCH_SERVE_test.json")
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", SERVE_OUT=out_path, **_SMALL)
+    env.pop("BENCH_STRICT_TPU", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "serve_bench.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=570,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [json.loads(ln) for ln in out.stdout.splitlines() if ln]
+
+    # headline LAST (the driver records the final line)
+    head = lines[-1]
+    assert head["metric"] == "serve_requests_per_sec"
+    assert head["unit"] == "requests/s"
+    assert head["value"] > 0
+    assert head["platform"] == "cpu"
+    assert head["recompiles_after_warmup"] == 0
+    for q in ("p50_ms", "p95_ms", "p99_ms"):
+        assert head[q] > 0
+
+    # one latency line per bucket rung, >= 3 rungs
+    bucket_lines = [l for l in lines
+                    if l["metric"] == "serve_bucket_latency"]
+    assert len(bucket_lines) >= 3
+    for rec in bucket_lines:
+        assert rec["p50_ms"] > 0 and rec["p99_ms"] >= rec["p50_ms"]
+        assert rec["throughput_rows_per_s"] > 0
+
+    # the artifact mirrors the lines and carries the parity verdict
+    with open(out_path) as f:
+        art = json.load(f)
+    assert art["schema"] == "BENCH_SERVE.v1"
+    assert art["recompiles_after_warmup"] == 0
+    assert len(art["bucket_latency"]) >= 3
+    assert art["parity"]["match"] is True
+    assert art["parity"]["engine_acc"] == art["parity"]["evaluate_acc"]
+    assert art["mixed_stream"]["requests"] == 40
+    assert art["mixed_stream"]["shed_deadline"] == 0
+    assert art["mixed_stream"]["shed_overload"] == 0
+    assert art["warmup"]["compile_count"] == 3  # one program per rung
+
+
+def test_serve_strict_tpu_refuses_cpu_backend(tmp_path):
+    """Same dominance property as bench.py's strict mode: a leaked
+    JAX_PLATFORMS=cpu under BENCH_STRICT_TPU=1 aborts before any
+    metric line or artifact is produced."""
+    out_path = str(tmp_path / "BENCH_SERVE_strict.json")
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", BENCH_STRICT_TPU="1",
+               SERVE_OUT=out_path, **_SMALL)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "serve_bench.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 1
+    assert "BENCH_STRICT_TPU set but the resolved backend" in out.stderr
+    assert not out.stdout.strip()  # no metric lines to mis-harvest
+    assert not os.path.exists(out_path)  # no artifact either

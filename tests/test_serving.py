@@ -1,0 +1,549 @@
+"""The serving subsystem: engine parity, bucket discipline, batcher,
+service loop.
+
+The load-bearing guarantees: (1) checkpoint -> ``ServingEngine.load``
+-> predictions BITWISE equal to what ``fedcore/evaluate.py`` computes
+in-memory on the same inputs (both checkpoint layouts, both the
+pre-mapped and fused-RFF paths); (2) a warmed engine serves any
+mixed-size stream with zero new compiles; (3) the stdlib service loop
+routes every request to its own result, sheds on deadline and on queue
+overflow, and never splits a request across batches.
+"""
+
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from fedamw_tpu.algorithms import FedAvg, prepare_setup
+from fedamw_tpu.data import load_dataset
+from fedamw_tpu.fedcore import make_evaluator
+from fedamw_tpu.serving import (DeadlineExceeded, MicroBatcher, Overloaded,
+                                ServiceStopped, ServingEngine,
+                                ServingService, bucket_for, coalesce,
+                                infer_model, split_results)
+from fedamw_tpu.utils.checkpoint import save_checkpoint
+
+
+def _trained(kernel_type="linear", D=64, parts=4, seed=3):
+    ds = load_dataset("digits", num_partitions=parts, alpha=0.5)
+    setup = prepare_setup(ds, D=D, kernel_type=kernel_type,
+                          kernel_par=0.1, seed=seed,
+                          rng=np.random.RandomState(seed))
+    res = FedAvg(setup, lr=0.5, epoch=1, round=2, seed=0,
+                 lr_mode="constant", return_state=True)
+    return ds, setup, res
+
+
+# -- bucket ladder ----------------------------------------------------
+
+def test_bucket_for_picks_smallest_rung():
+    assert bucket_for(1, (1, 8, 64)) == 1
+    assert bucket_for(2, (1, 8, 64)) == 8
+    assert bucket_for(8, (1, 8, 64)) == 8
+    assert bucket_for(9, (1, 8, 64)) == 64
+    with pytest.raises(ValueError, match="exceeds"):
+        bucket_for(65, (1, 8, 64))
+    with pytest.raises(ValueError, match="at least one"):
+        bucket_for(0, (1, 8, 64))
+
+
+def test_infer_model_from_params():
+    assert infer_model({"w": np.zeros((3, 5))}).name == "linear"
+    m = infer_model({"w1": np.zeros((16, 5)), "b1": np.zeros(16),
+                     "w2": np.zeros((3, 16))})
+    assert m.name == "mlp16"
+    with pytest.raises(ValueError, match="explicitly"):
+        infer_model({"conv1": np.zeros((3, 3, 1, 8))})
+
+
+def test_conv_model_serves_with_explicit_input_dim():
+    """Conv pytrees hide the raw width (the 'w' head sees post-conv
+    features), so the engine needs model= AND input_dim= — with both,
+    it serves raw image rows bitwise-equal to the in-memory apply."""
+    import jax
+
+    from fedamw_tpu.models.conv import conv_model
+
+    model = conv_model((4,))
+    d, C = 64, 3  # 8x8 images
+    params = model.init(jax.random.PRNGKey(0), d, C)
+    engine = ServingEngine(params, model=model, input_dim=d,
+                           buckets=(8,))
+    assert engine.input_dim == d
+    X = np.random.RandomState(9).randn(6, d).astype(np.float32)
+    np.testing.assert_array_equal(engine.predict(X),
+                                  np.asarray(model.apply(params, X)))
+
+
+# -- checkpoint -> engine parity (satellite: both layouts) ------------
+
+@pytest.mark.parametrize("layout", ["orbax", "pickle"])
+def test_checkpoint_roundtrip_serving_parity(tmp_path, monkeypatch,
+                                             layout):
+    """save_checkpoint -> ServingEngine.load -> predictions bitwise
+    equal to the in-memory model on the same inputs, and accuracy
+    identical to make_evaluator's, for BOTH checkpoint layouts."""
+    if layout == "pickle":
+        # poison the orbax import so save/load take the pickle branch
+        monkeypatch.setitem(sys.modules, "orbax", None)
+        monkeypatch.setitem(sys.modules, "orbax.checkpoint", None)
+    ds, setup, res = _trained(kernel_type="linear")
+    where = save_checkpoint(str(tmp_path / "ck"), res["params"],
+                            p=res["p"])
+    assert ("state.pkl" in where) == (layout == "pickle")
+
+    engine = ServingEngine.load(str(tmp_path / "ck"), buckets=(1, 8, 512))
+    X = np.asarray(setup.X_test)
+    got = engine.predict(X)
+    want = np.asarray(setup.model.apply(res["params"], setup.X_test))
+    np.testing.assert_array_equal(got, want)
+
+    evaluate = make_evaluator(setup.model.apply, setup.task)
+    _, acc = evaluate(res["params"], setup.X_test, setup.y_test)
+    served_acc = 100.0 * np.mean(
+        np.argmax(got, -1) == np.asarray(setup.y_test))
+    assert abs(served_acc - float(acc)) < 1e-4
+
+
+def test_fused_rff_serving_matches_evaluate(tmp_path):
+    """The raw-input path: a checkpoint saved with the RFF draw serves
+    RAW features through the fused cos(XW+b) predictor, bitwise equal
+    to mapping then applying in-memory (rff_map is inlined under the
+    engine's jit, same expression)."""
+    ds, setup, res = _trained(kernel_type="gaussian", D=128)
+    save_checkpoint(str(tmp_path / "ck"), res["params"], p=res["p"],
+                    rff=setup.rff)
+    engine = ServingEngine.load(str(tmp_path / "ck"), buckets=(512,))
+    assert engine.rff is not None
+    assert engine.input_dim == ds.d  # raw width, not the RFF width
+    got = engine.predict(np.asarray(ds.X_test, np.float32))
+    want = np.asarray(setup.model.apply(res["params"], setup.X_test))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fedamw_checkpoint_serving_accuracy_parity(tmp_path):
+    """The acceptance-criteria parity: a FedAMW-trained checkpoint
+    (learned mixture weights, RFF draw included — what exp.py
+    --save_models writes) served through the engine reproduces
+    fedcore/evaluate.py's test accuracy EXACTLY."""
+    from fedamw_tpu.algorithms import FedAMW
+
+    ds = load_dataset("digits", num_partitions=4, alpha=0.5)
+    setup = prepare_setup(ds, D=128, kernel_par=0.1, seed=5,
+                          rng=np.random.RandomState(5))
+    res = FedAMW(setup, lr=0.5, epoch=1, round=2, lambda_reg=1e-4,
+                 lr_p=1e-2, seed=0, lr_mode="constant",
+                 return_state=True)
+    save_checkpoint(str(tmp_path / "amw"), res["params"], p=res["p"],
+                    round_idx=2, rff=setup.rff)
+
+    engine = ServingEngine.load(str(tmp_path / "amw"))
+    evaluate = make_evaluator(setup.model.apply, setup.task)
+    _, acc = evaluate(res["params"], setup.X_test, setup.y_test)
+    logits = engine.predict(np.asarray(ds.X_test, np.float32))
+    served_acc = 100.0 * np.mean(
+        np.argmax(logits, -1) == np.asarray(setup.y_test))
+    assert served_acc == pytest.approx(float(acc), abs=1e-4)
+    # and the learned (non-uniform) mixture weights round-tripped too
+    from fedamw_tpu.utils.checkpoint import load_checkpoint
+
+    state = load_checkpoint(str(tmp_path / "amw"))
+    np.testing.assert_array_equal(np.asarray(state["p"]),
+                                  np.asarray(res["p"]))
+
+
+def test_feature_dtype_matches_narrow_feature_training():
+    """A bf16-feature training run (prepare_setup(feature_dtype=...)
+    maps via rff_map_to) is served with parity by passing the same
+    dtype to the engine: fused cast matches the training-side mapped
+    features bitwise (code-review finding — without the dtype the
+    engine would silently score f32 features against a bf16-trained
+    head)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedamw_tpu.ops.rff import rff_map_to, rff_params
+
+    rng = np.random.RandomState(8)
+    W, b = rff_params(jax.random.PRNGKey(0), 16, 32, 1.0)
+    params = {"w": rng.randn(3, 32).astype(np.float32)}
+    X = rng.randn(20, 16).astype(np.float32)
+    eng = ServingEngine(params, rff=(W, b), buckets=(64,),
+                        feature_dtype=jnp.bfloat16)
+    feats = rff_map_to(jnp.asarray(X), W, b, jnp.bfloat16)
+    want = np.asarray(jnp.asarray(feats) @ jnp.asarray(params["w"]).T)
+    np.testing.assert_array_equal(eng.predict(X), want)
+    # and the dtype genuinely changes the result vs the f32 path
+    f32 = ServingEngine(params, rff=(W, b), buckets=(64,))
+    assert not np.array_equal(eng.predict(X), f32.predict(X))
+    # pre-mapped path: the dtype must apply there too, not silently
+    # no-op (a bf16-feature linear-kernel run has no RFF draw at all)
+    pre = ServingEngine(params, buckets=(64,),
+                        feature_dtype=jnp.bfloat16)
+    feats_np = np.asarray(feats, np.float32)  # bf16->f32 is lossless
+    np.testing.assert_array_equal(pre.predict(feats_np), want)
+
+
+def test_feature_dtype_marker_round_trips_through_checkpoint(tmp_path):
+    """save_checkpoint(feature_dtype=...) persists the narrow-feature
+    marker and ServingEngine.load applies it automatically — no
+    operator memory required for bf16-parity serving."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedamw_tpu.ops.rff import rff_map_to, rff_params
+
+    rng = np.random.RandomState(10)
+    W, b = rff_params(jax.random.PRNGKey(2), 16, 32, 1.0)
+    params = {"w": rng.randn(3, 32).astype(np.float32)}
+    save_checkpoint(str(tmp_path / "ck"), params, rff=(W, b),
+                    feature_dtype=jnp.bfloat16)
+    eng = ServingEngine.load(str(tmp_path / "ck"), buckets=(64,))
+    assert str(eng.feature_dtype) == "bfloat16"
+    X = rng.randn(12, 16).astype(np.float32)
+    feats = rff_map_to(jnp.asarray(X), W, b, jnp.bfloat16)
+    want = np.asarray(jnp.asarray(feats) @ jnp.asarray(params["w"]).T)
+    np.testing.assert_array_equal(eng.predict(X), want)
+
+
+def test_padding_rows_are_inert():
+    """A bucket-padded batch returns the same logits for the valid rows
+    as an exact-fit call — rows are independent through the network."""
+    rng = np.random.RandomState(0)
+    params = {"w": rng.randn(3, 16).astype(np.float32)}
+    engine = ServingEngine(params, buckets=(8, 64))
+    X = rng.randn(5, 16).astype(np.float32)  # pads 5 -> 8
+    np.testing.assert_array_equal(
+        engine.predict(X), engine.predict(np.concatenate([X, X]))[:5])
+
+
+def test_single_row_and_oversized_requests():
+    rng = np.random.RandomState(1)
+    params = {"w": rng.randn(3, 16).astype(np.float32)}
+    engine = ServingEngine(params, buckets=(1, 8))
+    row = rng.randn(16).astype(np.float32)
+    out = engine.predict(row)
+    assert out.shape == (3,)  # single row in, single row out
+    np.testing.assert_array_equal(out, engine.predict(row[None, :])[0])
+    # 20 rows > max bucket 8: chunked transparently
+    X = rng.randn(20, 16).astype(np.float32)
+    assert engine.predict(X).shape == (20, 3)
+    np.testing.assert_array_equal(engine.predict(X)[3:7],
+                                  engine.predict(X[3:7]))
+    with pytest.raises(ValueError, match="expected"):
+        engine.predict(rng.randn(4, 7))
+
+
+def test_warmed_engine_serves_mixed_stream_with_zero_recompiles():
+    rng = np.random.RandomState(2)
+    params = {"w": rng.randn(4, 32).astype(np.float32)}
+    engine = ServingEngine(params, buckets=(1, 8, 64))
+    warm = engine.warmup()
+    assert warm == engine.compile_count == 3  # one program per rung
+    for n in (1, 2, 3, 7, 8, 9, 33, 64, 64, 5, 150, 1):
+        engine.predict(rng.randn(n, 32).astype(np.float32))
+    assert engine.compile_count == warm
+
+
+def test_engine_on_serving_mesh_matches_single_device():
+    """The GSPMD serving path: params replicated, batch axis sharded
+    P('batch', None) over the 8-device virtual mesh — same logits as
+    the unsharded engine, buckets rounded up to device multiples."""
+    from fedamw_tpu.parallel import make_serving_mesh
+
+    rng = np.random.RandomState(3)
+    params = {"w": rng.randn(3, 16).astype(np.float32)}
+    mesh = make_serving_mesh()
+    sharded = ServingEngine(params, buckets=(1, 8, 64), mesh=mesh)
+    assert sharded.buckets == (8, 64)  # rung 1 rounds up to 8 shards
+    plain = ServingEngine(params, buckets=(8, 64))
+    X = rng.randn(40, 16).astype(np.float32)
+    np.testing.assert_array_equal(sharded.predict(X), plain.predict(X))
+
+
+# -- batcher ----------------------------------------------------------
+
+def test_coalesce_split_roundtrip():
+    rng = np.random.RandomState(4)
+    payloads = [rng.randn(16).astype(np.float32),
+                rng.randn(3, 16).astype(np.float32),
+                rng.randn(1, 16).astype(np.float32)]
+    X, spans = coalesce(payloads)
+    assert X.shape == (5, 16)
+    outs = split_results(X, spans)  # identity engine
+    np.testing.assert_array_equal(outs[0], payloads[0])  # 1-D restored
+    np.testing.assert_array_equal(outs[1], payloads[1])
+    assert outs[2].shape == (1, 16)
+
+
+def test_micro_batcher_routes_results():
+    rng = np.random.RandomState(5)
+    params = {"w": rng.randn(3, 16).astype(np.float32)}
+    engine = ServingEngine(params, buckets=(8, 64))
+    payloads = [rng.randn(k, 16).astype(np.float32) for k in (2, 5, 1)]
+    outs = MicroBatcher(engine).run(payloads)
+    for x, o in zip(payloads, outs):
+        np.testing.assert_array_equal(o, engine.predict(x))
+    assert MicroBatcher(engine).run([]) == []
+
+
+def test_drain_never_splits_a_request_and_hands_back_holdover():
+    import queue as queue_mod
+
+    from fedamw_tpu.serving import drain
+
+    q = queue_mod.Queue()
+    for k in (4, 3):
+        q.put(np.zeros((k, 8), np.float32))
+    batch, held = drain(q, np.zeros((2, 8), np.float32), max_rows=8,
+                        max_wait=0.0)
+    # 2 + 4 fit; the 3-row request would exceed 8 -> handed back as the
+    # next batch's seed (NOT re-queued at the tail, where a sustained
+    # stream of fresh arrivals could starve it past its deadline)
+    assert [b.shape[0] for b in batch] == [2, 4]
+    assert held is not None and held.shape[0] == 3
+    assert q.qsize() == 0
+    # exact-fit and timeout drains have no holdover
+    batch, held = drain(q, np.zeros((8, 8), np.float32), max_rows=8,
+                        max_wait=0.0)
+    assert [b.shape[0] for b in batch] == [8] and held is None
+
+
+# -- service loop -----------------------------------------------------
+
+def _engine(seed=6, d=16, C=3, buckets=(8, 64)):
+    rng = np.random.RandomState(seed)
+    return ServingEngine({"w": rng.randn(C, d).astype(np.float32)},
+                         buckets=buckets)
+
+
+def test_service_resolves_each_future_with_its_own_logits():
+    engine = _engine()
+    rng = np.random.RandomState(7)
+    payloads = [rng.randn(k, 16).astype(np.float32)
+                for k in (1, 4, 2, 8, 3)]
+    with ServingService(engine, max_wait_ms=1.0) as svc:
+        futs = [svc.submit(x) for x in payloads]
+        for x, f in zip(payloads, futs):
+            np.testing.assert_array_equal(f.result(timeout=30),
+                                          engine.predict(x))
+        assert svc.metrics.requests_served == len(payloads)
+        assert svc.metrics.latency.count == len(payloads)
+
+
+def test_service_sheds_expired_deadline():
+    engine = _engine()
+    svc = ServingService(engine, max_wait_ms=1.0)
+    # submit BEFORE start: the request sits queued past its deadline,
+    # deterministically (no race against a live worker)
+    svc._thread = object()  # satisfy the started check for submit
+    fut = svc.submit(np.zeros((2, 16), np.float32), timeout_s=0.0)
+    time.sleep(0.01)
+    svc._thread = None
+    with svc:
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=30)
+    assert svc.metrics.shed_deadline == 1
+
+
+def test_service_sheds_on_queue_overflow():
+    engine = _engine()
+    svc = ServingService(engine, max_queue=2)
+    svc._thread = object()  # queue fills while no worker drains
+    svc.submit(np.zeros((1, 16), np.float32))
+    svc.submit(np.zeros((1, 16), np.float32))
+    with pytest.raises(Overloaded):
+        svc.submit(np.zeros((1, 16), np.float32))
+    assert svc.metrics.shed_overload == 1
+    assert svc.metrics.queue_depth_peak >= 2
+    svc._thread = None
+    with svc:  # the two accepted requests still drain gracefully
+        pass
+    assert svc.metrics.requests_served == 2
+
+
+def test_service_stop_without_drain_sheds_backlog():
+    engine = _engine()
+    svc = ServingService(engine)
+    svc._thread = object()
+    fut = svc.submit(np.zeros((1, 16), np.float32))
+    svc._thread = None
+    svc.start()
+    svc.stop(drain_queue=False)
+    # the backlog future is resolved either way (served if the worker
+    # got to it first, shed otherwise) — never left hanging
+    assert fut.done()
+
+
+def test_service_propagates_engine_errors_and_worker_survives():
+    """An engine-side failure resolves every future in the batch with
+    the error and leaves the worker alive for later traffic — never a
+    silently dead thread with stranded futures."""
+    engine = _engine()
+    real_predict = engine.predict
+    state = {"failed": False}
+
+    def flaky(X):
+        if not state["failed"]:
+            state["failed"] = True
+            raise RuntimeError("transient engine failure")
+        return real_predict(X)
+
+    engine.predict = flaky
+    svc = ServingService(engine, max_wait_ms=20.0)
+    # queue both before the worker starts so they land in ONE batch
+    svc._thread = object()
+    f1 = svc.submit(np.zeros((2, 16), np.float32))
+    f2 = svc.submit(np.zeros((3, 16), np.float32))
+    svc._thread = None
+    with svc:
+        for f in (f1, f2):
+            with pytest.raises(RuntimeError, match="transient"):
+                f.result(timeout=30)
+        ok = svc.submit(np.zeros((2, 16), np.float32))
+        np.testing.assert_array_equal(
+            ok.result(timeout=30),
+            real_predict(np.zeros((2, 16), np.float32)))
+
+
+def test_submit_requires_started_service():
+    with pytest.raises(RuntimeError, match="not started"):
+        ServingService(_engine()).submit(np.zeros((1, 16), np.float32))
+
+
+def test_cancelled_future_does_not_kill_the_worker():
+    """A caller cancelling its pending Future must not crash the
+    worker on resolution (set_result on a cancelled Future raises
+    InvalidStateError) — the rest of the batch and all later traffic
+    keep being served (code-review finding, reproduced live)."""
+    engine = _engine()
+    svc = ServingService(engine, max_wait_ms=20.0)
+    svc._thread = object()  # queue before start: same batch, no races
+    f1 = svc.submit(np.zeros((2, 16), np.float32))
+    f2 = svc.submit(np.ones((2, 16), np.float32))
+    assert f1.cancel()
+    svc._thread = None
+    with svc:
+        np.testing.assert_array_equal(
+            f2.result(timeout=30),
+            engine.predict(np.ones((2, 16), np.float32)))
+        later = svc.submit(np.ones((3, 16), np.float32))
+        assert later.result(timeout=30).shape == (3, 3)
+
+
+def test_submit_refused_once_stopping():
+    """Refusing new work after stop() begins is what guarantees the
+    worker's final drain terminates under sustained submit load."""
+    with ServingService(_engine()) as svc:
+        svc._stop.set()
+        with pytest.raises(ServiceStopped, match="stopping"):
+            svc.submit(np.zeros((1, 16), np.float32))
+        svc._stop.clear()
+
+
+def test_stop_sweep_resolves_requests_the_worker_never_saw():
+    """A submit racing stop() can land its request after the worker
+    exits; the post-join sweep must resolve that Future (served on a
+    graceful stop, shed on drain_queue=False) instead of stranding the
+    caller forever and leaking a depth slot (code-review finding)."""
+    from concurrent.futures import Future
+
+    from fedamw_tpu.serving.service import _Request
+
+    for drain_queue, check in ((True, "served"), (False, "shed")):
+        engine = _engine()
+        svc = ServingService(engine)
+        fut: Future = Future()
+        x = np.ones((2, 16), np.float32)
+        # simulate the race: the request lands post-join, as if submit
+        # passed the liveness check concurrently with stop()
+        svc._q.put(_Request(x=x, future=fut, t_submit=0.0, deadline=None))
+        with svc._depth_lock:
+            svc._depth += 1
+        svc._sweep_leftovers(drain_queue)
+        if check == "served":
+            np.testing.assert_array_equal(fut.result(timeout=5),
+                                          engine.predict(x))
+            # sweep-served requests count in metrics like worker-served
+            assert svc.metrics.requests_served == 1
+            assert svc.metrics.latency.count == 1
+        else:
+            # shutdown shed is NOT a deadline violation: distinct
+            # exception and counter, so operators and retry logic can
+            # tell a deliberate stop from a timeout
+            with pytest.raises(ServiceStopped):
+                fut.result(timeout=5)
+            assert svc.metrics.shed_shutdown == 1
+            assert svc.metrics.shed_deadline == 0
+        assert svc._depth == 0  # the capacity slot was reclaimed
+
+    # an already-expired leftover is shed, not served late — the sweep
+    # honors deadlines exactly like the worker's dequeue check
+    engine = _engine()
+    svc = ServingService(engine)
+    fut = Future()
+    svc._q.put(_Request(x=np.ones((2, 16), np.float32), future=fut,
+                        t_submit=0.0, deadline=0.0))
+    with svc._depth_lock:
+        svc._depth += 1
+    svc._sweep_leftovers(True)
+    with pytest.raises(DeadlineExceeded, match="expired"):
+        fut.result(timeout=5)
+    assert svc.metrics.shed_deadline == 1 and svc._depth == 0
+
+
+def test_submit_rejects_malformed_payload_synchronously():
+    """A 0-d/3-d or wrong-width payload must fail in the CALLER's
+    thread: queued, it would poison the coalesced batch and fail OTHER
+    callers' valid requests alongside (code-review finding)."""
+    with ServingService(_engine()) as svc:
+        for bad in (1.0, np.zeros((2, 3, 4), np.float32),
+                    np.zeros((2, 7), np.float32),   # width != 16
+                    np.zeros((0, 16), np.float32),  # zero rows
+                    np.zeros(7, np.float32)):
+            with pytest.raises(ValueError, match="request must be"):
+                svc.submit(bad)
+        assert svc.metrics.shed_overload == 0  # rejected, not shed
+
+
+def test_overload_bound_is_atomic_under_concurrent_submits():
+    """The max_queue bound must hold under a concurrent submit storm
+    (the depth check is a locked counter, not a qsize()-then-put
+    race): accepted requests never exceed max_queue before the worker
+    starts draining."""
+    import threading as th
+
+    engine = _engine()
+    svc = ServingService(engine, max_queue=8)
+    svc._thread = object()  # no worker: the bound alone limits depth
+    accepted, errs = [], []
+
+    def storm():
+        try:
+            accepted.append(svc.submit(np.zeros((1, 16), np.float32)))
+        except Overloaded:
+            errs.append(1)
+
+    threads = [th.Thread(target=storm) for _ in range(32)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(accepted) == 8 and len(errs) == 24
+    assert svc.metrics.shed_overload == 24
+    svc._thread = None
+    with svc:  # accepted backlog drains gracefully
+        for f in accepted:
+            f.result(timeout=30)
+    assert svc.metrics.requests_served == 8
+
+
+# -- registry surface -------------------------------------------------
+
+def test_registry_exposes_serving():
+    from fedamw_tpu import registry
+
+    serving = registry.get_serving()
+    assert serving.ServingEngine is ServingEngine
