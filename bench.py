@@ -353,14 +353,9 @@ def bench_torch(ds, D, rounds, algorithm="FedAvg", epoch=EPOCHS, batch_size=32,
 
 
 def main():
-    platforms = os.environ.get("JAX_PLATFORMS", "")
-    if platforms:
-        # honor the env var even under this container's sitecustomize,
-        # which force-registers the axon TPU plugin (the config update
-        # must land before the first backend query)
-        import jax
+    from bench_common import reapply_jax_platforms, strict_tpu_abort
 
-        jax.config.update("jax_platforms", platforms)
+    platforms = reapply_jax_platforms()
     cpu_fallback = False
     if os.environ.get("BENCH_FORCE_FALLBACK"):
         # skip the 180 s probe when the tunnel is known-down (driver /
@@ -423,18 +418,13 @@ def main():
     import jax
 
     platform = jax.default_backend()
-    if os.environ.get("BENCH_STRICT_TPU"):
-        from fedamw_tpu.fedcore.client import _TPU_BACKENDS
-
-        # strict mode certifies TPU evidence: a healthy probe is not
-        # enough — a leaked JAX_PLATFORMS=cpu or BENCH_FORCE_FALLBACK
-        # (both honored above) would otherwise run the whole bench on
-        # CPU with rc=0 and let the window harvest mark a CPU capture
-        # green; strict dominates every downgrade path
-        if platform not in _TPU_BACKENDS:
-            print(f"# bench aborted: BENCH_STRICT_TPU set but the "
-                  f"resolved backend is {platform!r}", file=sys.stderr)
-            raise SystemExit(1)
+    # strict mode certifies TPU evidence: a healthy probe is not
+    # enough — a leaked JAX_PLATFORMS=cpu or BENCH_FORCE_FALLBACK
+    # (both honored above) would otherwise run the whole bench on
+    # CPU with rc=0 and let the window harvest mark a CPU capture
+    # green; strict dominates every downgrade path (shared helper:
+    # bench_common.strict_tpu_abort, mirrored by serve_bench.py)
+    strict_tpu_abort("bench", platform)
 
     if os.environ.get("BENCH_SWEEP_ONLY"):
         # sweep-only run (tpu_window.sh step 5/5): skip the headline /

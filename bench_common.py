@@ -1,0 +1,50 @@
+"""Shared strict-backend prologue for the bench drivers.
+
+``bench.py`` and ``serve_bench.py`` used to carry deliberately-mirrored
+copies of two guards (each pinned by its own contract test); the
+ROADMAP open item asked for one helper both call so the guards cannot
+drift. The two pieces:
+
+- :func:`reapply_jax_platforms` — honor ``JAX_PLATFORMS`` even under
+  this container's ``sitecustomize``, which force-registers the axon
+  TPU plugin and programmatically overrides the platform selection at
+  interpreter startup; the config update must land before the first
+  backend query (with a remote-TPU tunnel down, env-only selection can
+  hang in plugin init).
+- :func:`strict_tpu_abort` — the ``BENCH_STRICT_TPU=1`` certification
+  gate: a resolved non-TPU backend aborts rc=1 BEFORE any metric line
+  or artifact is produced, so a leaked ``JAX_PLATFORMS=cpu`` or
+  ``BENCH_FORCE_FALLBACK`` can never be harvested as TPU evidence.
+  Strict mode dominates every downgrade path; pinned in
+  ``tests/test_bench_contract.py`` and ``tests/test_serve_contract.py``.
+"""
+
+import os
+import sys
+
+
+def reapply_jax_platforms() -> str:
+    """Re-apply ``JAX_PLATFORMS`` to the jax config over the
+    container's sitecustomize. Returns the env value ('' when unset)
+    so callers can branch on an explicit selection."""
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
+    return platforms
+
+
+def strict_tpu_abort(tool: str, platform: str) -> None:
+    """Under ``BENCH_STRICT_TPU=1``, abort (rc=1, message on stderr
+    naming ``tool``) unless the RESOLVED backend is a TPU one — a
+    healthy probe is not enough, since an in-process platform
+    downgrade resolves after it. No-op when strict mode is off."""
+    if not os.environ.get("BENCH_STRICT_TPU"):
+        return
+    from fedamw_tpu.fedcore.client import _TPU_BACKENDS
+
+    if platform not in _TPU_BACKENDS:
+        print(f"# {tool} aborted: BENCH_STRICT_TPU set but the "
+              f"resolved backend is {platform!r}", file=sys.stderr)
+        raise SystemExit(1)

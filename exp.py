@@ -77,9 +77,30 @@ def parse_args():
                          "default")
     ap.add_argument("--participation", type=float, default=1.0,
                     help="extension: per-round Bernoulli client sampling "
-                         "for FedAvg/FedProx (FedAMW always runs full "
-                         "participation; reference trains every client, "
-                         "tools.py:340)")
+                         "for the round-based algorithms (jax FedAMW "
+                         "runs its p-solver masked over the present "
+                         "clients; the torch twin pins the reference's "
+                         "full-participation FedAMW; reference trains "
+                         "every client, tools.py:340)")
+    ap.add_argument("--faults", type=str, default=None,
+                    metavar="SPEC",
+                    help="extension (jax): deterministic per-round fault "
+                         "injection for FedAvg/FedProx/FedAMW — "
+                         "'drop=0.1,straggle=0.2:0.5,corrupt=0.05:nan,"
+                         "seed=7' (fedcore.faults; rates per kind, "
+                         "straggle takes an update fraction, corrupt a "
+                         "mode nan|inf|sign|scale[:S]). The plan seed "
+                         "is offset per repeat; per-round fault/"
+                         "quarantine counts are reported after each "
+                         "algorithm")
+    ap.add_argument("--robust_agg", type=str, default="mean",
+                    metavar="mean|median|trim:K|clip:R[+...]",
+                    help="extension (jax): robust aggregation for the "
+                         "round-based algorithms (fedcore.robust) — "
+                         "non-finite reports are always quarantined "
+                         "under faults; this adds norm clipping and/or "
+                         "coordinate-wise trimmed-mean/median in place "
+                         "of the weighted average")
     ap.add_argument("--server_opt", type=str, default="none",
                     choices=["none", "sgd", "adam", "yogi", "adagrad"],
                     help="extension: FedOpt server optimizer on the "
@@ -147,6 +168,20 @@ def parse_args():
 
         try:  # validate at the CLI boundary, not mid-run
             resolve_p_guard(args.p_guard)
+        except ValueError as e:
+            ap.error(str(e))
+    if args.faults is not None or args.robust_agg != "mean":
+        if args.backend != "jax":
+            ap.error("--faults/--robust_agg are jax-backend extensions "
+                     "(the torch twin pins the reference's clean "
+                     "full-report rounds)")
+        from fedamw_tpu.fedcore.faults import FaultSpec
+        from fedamw_tpu.fedcore.robust import parse_robust_spec
+
+        try:  # validate at the CLI boundary, not after hours of repeats
+            if args.faults is not None:
+                FaultSpec.parse(args.faults)
+            parse_robust_spec(args.robust_agg)
         except ValueError as e:
             ap.error(str(e))
     if args.multihost:
@@ -324,7 +359,18 @@ _RESUME_LEGACY_DEFAULTS = {"model": "linear", "data_dir": "datasets",
                            # committed partials predate the guard and
                            # are unguarded), so a keyless partial IS
                            # an unguarded run
-                           "p_guard": None}
+                           "p_guard": None,
+                           # fault plane (this PR): a partial without
+                           # these keys is by construction a clean run
+                           "faults": None, "robust_agg": "mean",
+                           # FedAMW used to reject participation<1, so
+                           # a legacy partial's FedAMW rows are always
+                           # full-participation runs; signing the value
+                           # FedAMW now actually uses makes a resume
+                           # that would mix old full-participation
+                           # FedAMW repeats with new masked ones abort
+                           # instead of silently mixing
+                           "amw_participation": 1.0}
 
 
 def _resume_config(args) -> dict:
@@ -347,6 +393,11 @@ def _resume_config(args) -> dict:
     # resume (round-5 review)
     cfg["p_guard"] = (_effective_p_guard() if args.backend == "jax"
                       else None)
+    cfg["faults"] = args.faults
+    cfg["robust_agg"] = args.robust_agg
+    # see _RESUME_LEGACY_DEFAULTS: jax FedAMW now honors participation
+    cfg["amw_participation"] = (args.participation
+                                if args.backend == "jax" else 1.0)
     return cfg
 
 
@@ -501,26 +552,49 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete,
             elif t == 0:
                 print("--save_models is implemented for the jax backend; "
                       f"ignored for backend={args.backend}")
-        # extensions apply to the fixed-weight algorithms only (FedAMW
-        # rejects both; its learned mixture weights assume every
-        # client's logits and the reference aggregation rule)
+        # server_opt applies to the fixed-weight algorithms only
+        # (FedAMW's learned mixture weights reject a server optimizer);
+        # participation and the fault plane apply to all three
+        # round-based algorithms on the jax backend (the torch twin
+        # pins the reference's full-participation FedAMW)
         ext = dict(participation=args.participation,
                    server_opt=args.server_opt, server_lr=args.server_lr)
+        amw_ext = ({"participation": args.participation}
+                   if args.backend == "jax" else {})
+        fault_ext = {}
+        if args.faults is not None or args.robust_agg != "mean":
+            # argparse-guarded to the jax backend; the plan seed is
+            # offset per repeat so repeats see independent fault draws
+            # (like the data/model seeds), deterministically
+            fault_ext["robust_agg"] = args.robust_agg
+            if args.faults is not None:
+                import dataclasses as _dc
+
+                from fedamw_tpu.fedcore.faults import FaultSpec
+
+                spec = FaultSpec.parse(args.faults)
+                fault_ext["faults"] = _dc.replace(spec, seed=spec.seed + t)
         if t == 0 and (args.participation < 1.0
-                       or args.server_opt != "none"):
-            print(f"extensions on FedAvg/FedProx: {ext} "
-                  "(FedAMW runs the reference protocol)")
-        avg = algos["FedAvg"](setup, lr=lr, **ext, **round_common)
+                       or args.server_opt != "none" or fault_ext):
+            print(f"extensions on FedAvg/FedProx: {ext} + {fault_ext}; "
+                  f"FedAMW: {amw_ext} + {fault_ext}")
+        avg = algos["FedAvg"](setup, lr=lr, **ext, **fault_ext,
+                              **round_common)
         prox = algos["FedProx"](setup, lr=lr, prox=True, mu=mu, **ext,
-                                **round_common)
+                                **fault_ext, **round_common)
         amw = algos["FedAMW"](setup, lr=lr, lambda_reg_if=True,
-                              lambda_reg=lam, lr_p=lr_p, **round_common)
+                              lambda_reg=lam, lr_p=lr_p, **amw_ext,
+                              **fault_ext, **round_common)
         for name, res, row in (("FedAvg", avg, 3), ("FedProx", prox, 4),
                                ("FedAMW", amw, 5)):
             train_mat[row, :, t] = res["train_loss"]
             error_mat[row, :, t] = res["test_loss"]
             acc_mat[row, :, t] = res["test_acc"]
             print(f"{name}: final acc {res['test_acc'][-1]:.2f}")
+            if "fault_counts" in res:
+                from fedamw_tpu.utils.reporting import format_fault_report
+
+                print(format_fault_report(name, res["fault_counts"]))
             if "params" in res and _is_writer(args):
                 # one writer (matches the result-pickle gate): global
                 # params/p are replicated, so process 0 has the full

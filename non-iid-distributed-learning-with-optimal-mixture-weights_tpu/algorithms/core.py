@@ -42,8 +42,21 @@ from ..fedcore import (
     participation_weights,
     weighted_average,
 )
+from ..fedcore.faults import inject_fault_row, resolve_fault_plan
+from ..fedcore.robust import (
+    clip_update_norms,
+    make_robust_aggregator,
+    parse_robust_spec,
+    sanitize_updates,
+)
 from ..ops.schedule import lr_schedule_array
 from .common import FedSetup, result_tuple
+
+# Introspection hook: the most recent jitted round trainer _round_based
+# dispatched, so tests can pin its XLA cache size across runs (the
+# zero-recompile fault-plane contract, tests/test_faults.py) without
+# reconstructing the memoization key.
+_LAST_TRAIN_FN = None
 
 
 # The two seed derivations below are the single source of truth for how
@@ -99,7 +112,8 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                           sequential, shard_factor, verbose=False,
                           participation=1.0, kernel_env=("", "", "", ""),
                           start_round=0, stop_round=None,
-                          server_opt="none", server_lr=1.0):
+                          server_opt="none", server_lr=1.0,
+                          faults_on=False, robust_agg="mean"):
     # stop_round: required resolved int (the sole caller, _round_based,
     # always passes it; no None-resolution here so the cache cannot hold
     # duplicate programs for equivalent keys)
@@ -144,14 +158,72 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
             jax.debug.callback(_print_round, t, train_loss_t, tl, ta,
                                ordered=False)
 
+    # Fault plane / robust aggregation (fedcore.faults / fedcore.robust).
+    # Everything below is STATIC configuration: with faults_on=False and
+    # the default "mean" spec, the traced graph is bit-identical to a
+    # build without the fault plane (the branches below cut at trace
+    # time) — the regression contract of tests/test_faults.py. When
+    # active, the per-round plan rows arrive as scanned inputs, so a
+    # different plan reuses the same compiled program (zero recompiles).
+    rspec = parse_robust_spec(robust_agg)
+    robust_on = not rspec.is_default
+    aggregate_robust = make_robust_aggregator(rspec)
+
+    def guard_faults(params, stacked, losses, present, part_key_t,
+                     fault_row):
+        """Shared fault/participation/sanitize prologue of a 'fancy'
+        round: starting from the valid-client mask in ``present``,
+        returns the cleaned reports, the final present-client mask,
+        and the round's quarantine count."""
+        if participation < 1.0:
+            present = present * (
+                jax.random.uniform(part_key_t, present.shape)
+                < participation
+            ).astype(jnp.float32)
+        if faults_on:
+            f_drop, f_scale, f_poison, f_fill = fault_row
+            stacked, losses = inject_fault_row(
+                params, stacked, losses, f_scale, f_poison, f_fill)
+            present = present * (1.0 - f_drop)
+        reported = present
+        stacked, losses, ok = sanitize_updates(params, stacked, losses)
+        present = present * ok
+        quar_t = jnp.sum(reported * (1.0 - ok))
+        return stacked, losses, present, quar_t
+
+    def robust_round_aggregate(params, stacked, w_t, present):
+        """Clip + robust reduction + the all-absent no-op gate. The
+        gate checks weight MASS for the mean aggregator (a learned p
+        could put zero or negative total mass on the present set) and
+        headcount for the order-statistic ones (which ignore weights)."""
+        if rspec.clip is not None:
+            stacked = clip_update_norms(params, stacked, rspec.clip)
+        agg = aggregate_robust(stacked, w_t, present)
+        if rspec.agg == "mean":
+            ok_round = jnp.sum(jnp.abs(w_t)) > 0
+        else:
+            ok_round = jnp.sum(present) > 0
+        return jax.tree.map(
+            lambda new, old: jnp.where(ok_round, new, old), agg, params)
+
     if aggregation == "learned":
         solve, init_opt = make_p_solver(task, n_val, val_batch_size, lr_p,
                                         momentum=0.9)
 
+        # partial participation for the LEARNED path (extension; the
+        # reference fits p over every client's cached logits,
+        # tools.py:435-453): the p-solver runs masked over the present
+        # subset — an absent client's mixture weight and momentum are
+        # zeroed before the solve and the masked gradient keeps them at
+        # zero (see the body), so absent/quarantined clients carry
+        # exactly zero learned mass each round they miss.
+        use_part = participation < 1.0
+        fancy = faults_on or robust_on or use_part
+
         @jax.jit
         def train(seed, X, y, idx, mask, X_val, y_val,
                   X_test, y_test, lrs, p0, sizes, mu, lam,
-                  params0=None, p_opt0=None):
+                  params0=None, p_opt0=None, fault_rows=None):
             keys, params = prologue(seed)
             if params0 is not None:  # resume / warm start
                 params = params0
@@ -167,27 +239,82 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                     jax.tree.structure(opt_state), list(p_opt0))
             # inert padded clients (mesh-even packing) never earn weight
             client_valid = (sizes > 0).astype(jnp.float32)
+            xs = [jnp.arange(start_round, stop), lrs, keys, pkeys]
+            if use_part or faults_on:
+                # same stream as the fixed path's participation keys,
+                # generated for the FULL horizon and sliced (resume)
+                xs.append(jax.random.split(
+                    jax.random.PRNGKey(seed + 2),
+                    rounds)[start_round:stop])
+            if faults_on:
+                xs.extend(fault_rows)
 
             def body(carry, inp):
                 params, p, opt_state = carry
-                t, lr_t, keys_t, pkey_t = inp
+                if faults_on:
+                    (t, lr_t, keys_t, pkey_t, part_key_t,
+                     f_drop, f_scale, f_poison, f_fill) = inp
+                    fault_row = (f_drop, f_scale, f_poison, f_fill)
+                elif use_part:
+                    t, lr_t, keys_t, pkey_t, part_key_t = inp
+                    fault_row = None
+                else:
+                    t, lr_t, keys_t, pkey_t = inp
+                    part_key_t = fault_row = None
                 stacked, losses, _ = round_fn(
                     params, X, y, idx, mask, keys_t, lr_t, mu, lam,
                 )
-                train_loss_t = jnp.sum(p * losses)  # current p (tools.py:434)
-                logits = client_logits(apply_fn, stacked, X_val)
-                p, opt_state, _, _ = solve(
-                    logits, y_val, p, opt_state, pkey_t, rounds,
-                    client_valid=client_valid,
-                )
-                params = weighted_average(stacked, p)
+                if fancy:
+                    stacked, losses, present, quar_t = guard_faults(
+                        params, stacked, losses, client_valid,
+                        part_key_t, fault_row)
+                    # Absent/quarantined clients carry EXACTLY zero
+                    # mixture mass: p and its momentum are masked
+                    # before the solve (a client whose report never
+                    # arrived must not shape the mixture through a
+                    # stale weight), the masked gradient keeps both at
+                    # zero through the round's epochs, and a returning
+                    # client re-earns weight from zero. Under the
+                    # simplex p-guard the projection also runs over the
+                    # present subset, keeping p on the masked simplex
+                    # (the recommended pairing for dropout runs).
+                    p_m = p * present
+                    opt_m = jax.tree.map(lambda m: m * present,
+                                         opt_state)
+                    train_loss_t = jnp.sum(p_m * losses)
+                    logits = client_logits(apply_fn, stacked, X_val)
+                    p_s, opt_s, _, _ = solve(
+                        logits, y_val, p_m, opt_m, pkey_t, rounds,
+                        client_valid=present,
+                    )
+                    # an all-absent round is a FULL no-op: the masked
+                    # p/momentum would otherwise be zeroed for good
+                    any_p = jnp.sum(present) > 0
+                    p = jnp.where(any_p, p_s, p)
+                    opt_state = jax.tree.map(
+                        lambda new, old: jnp.where(any_p, new, old),
+                        opt_s, opt_state)
+                    w_t = participation_weights(p_s, present)
+                    params = robust_round_aggregate(
+                        params, stacked, w_t, present)
+                else:
+                    quar_t = jnp.float32(0.0)
+                    train_loss_t = jnp.sum(p * losses)  # current p (tools.py:434)
+                    logits = client_logits(apply_fn, stacked, X_val)
+                    p, opt_state, _, _ = solve(
+                        logits, y_val, p, opt_state, pkey_t, rounds,
+                        client_valid=client_valid,
+                    )
+                    params = weighted_average(stacked, p)
                 tl, ta = evaluate(params, X_test, y_test)
                 stream_metrics(t, train_loss_t, tl, ta)
-                return (params, p, opt_state), (train_loss_t, tl, ta)
+                ys = (train_loss_t, tl, ta)
+                if faults_on:
+                    ys = ys + (quar_t,)
+                return (params, p, opt_state), ys
 
             (params, p, opt_state), metrics = jax.lax.scan(
-                body, (params, p, opt_state),
-                (jnp.arange(start_round, stop), lrs, keys, pkeys),
+                body, (params, p, opt_state), tuple(xs),
             )
             return jnp.stack(metrics), params, p, opt_state
 
@@ -224,7 +351,8 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
 
     @jax.jit
     def train(seed, X, y, idx, mask, X_test, y_test, lrs,
-              p_fixed, sizes, mu, lam, params0=None, server_opt0=None):
+              p_fixed, sizes, mu, lam, params0=None, server_opt0=None,
+              fault_rows=None):
         keys, params = prologue(seed)
         if params0 is not None:  # resume / warm start
             params = params0
@@ -240,14 +368,38 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
         part_keys = jax.random.split(
             jax.random.PRNGKey(seed + 2), rounds)[start_round:stop]
         valid = (sizes > 0).astype(jnp.float32)
+        xs = [jnp.arange(start_round, stop), lrs, keys, part_keys]
+        if faults_on:
+            xs.extend(fault_rows)
 
         def body(carry, inp):
             params, opt_state = carry
-            t, lr_t, keys_t, part_key_t = inp
+            if faults_on:
+                (t, lr_t, keys_t, part_key_t,
+                 f_drop, f_scale, f_poison, f_fill) = inp
+                fault_row = (f_drop, f_scale, f_poison, f_fill)
+            else:
+                t, lr_t, keys_t, part_key_t = inp
+                fault_row = None
             stacked, losses, _ = round_fn(
                 params, X, y, idx, mask, keys_t, lr_t, mu, lam,
             )
-            if participation < 1.0:
+            quar_t = jnp.float32(0.0)
+            if faults_on or robust_on:
+                # the fault/robust round: participation, drop, and
+                # quarantine masks fold into one present-client set;
+                # both weight families renormalize over it and the
+                # (possibly order-statistic) aggregate is gated back to
+                # the old params when the round has nobody left
+                stacked, losses, present, quar_t = guard_faults(
+                    params, stacked, losses, valid, part_key_t,
+                    fault_row)
+                w_t = participation_weights(agg_w, present)
+                loss_w = participation_weights(p_fixed, present)
+                agg = robust_round_aggregate(params, stacked, w_t,
+                                             present)
+                train_loss_t = jnp.sum(loss_w * losses)
+            elif participation < 1.0:
                 part = valid * (
                     jax.random.uniform(part_key_t, valid.shape)
                     < participation
@@ -278,7 +430,10 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 params = optax.apply_updates(params, updates)
             tl, ta = evaluate(params, X_test, y_test)
             stream_metrics(t, train_loss_t, tl, ta)
-            return (params, opt_state), (train_loss_t, tl, ta)
+            ys = (train_loss_t, tl, ta)
+            if faults_on:
+                ys = ys + (quar_t,)
+            return (params, opt_state), ys
 
         opt_state0 = (() if server_tx is None
                       else server_tx.init(params))
@@ -289,8 +444,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
             opt_state0 = jax.tree.unflatten(
                 jax.tree.structure(opt_state0), list(server_opt0))
         (params, opt_state), metrics = jax.lax.scan(
-            body, (params, opt_state0),
-            (jnp.arange(start_round, stop), lrs, keys, part_keys)
+            body, (params, opt_state0), tuple(xs)
         )
         return jnp.stack(metrics), params, p_fixed, opt_state
 
@@ -324,12 +478,26 @@ def _cached_centralized_trainer(init_fn, apply_fn, task, D, num_classes,
 def _reject_partial(participation, algo: str):
     """One-shot algorithms have no per-round participation concept; a
     silently ignored participation<1 would mislabel a full-participation
-    run as partial (round-based FedAMW already rejects loudly)."""
+    run as partial. (Round-based FedAMW used to reject too; its
+    p-solver now runs masked, so every round-based algorithm accepts
+    partial participation.)"""
     if participation != 1.0:
         raise ValueError(
             f"{algo} assumes full participation (it has no communication "
             f"rounds to sample clients in); got participation="
             f"{participation}")
+
+
+def _reject_faults(faults, robust_agg, algo: str):
+    """The fault plane is a per-ROUND concept (``fedcore.faults``); the
+    one-shot algorithms have no rounds to schedule faults over, and a
+    silently swallowed ``faults=`` (these functions accept ``**_``)
+    would mislabel a clean run as fault-injected."""
+    if faults is not None or robust_agg != "mean":
+        raise ValueError(
+            f"{algo} has no communication rounds to inject faults into "
+            f"or robustly aggregate over; faults=/robust_agg= apply to "
+            f"FedAvg/FedProx/FedNova/FedAMW")
 
 
 def Centralized(
@@ -339,11 +507,14 @@ def Centralized(
     batch_size=32,
     seed=0,
     participation=1.0,
+    faults=None,
+    robust_agg="mean",
     **_,
 ):
     """Upper-bound baseline: all shards pooled, one long local run
     (reference ``tools.py:240-255``; called with epoch*Round epochs)."""
     _reject_partial(participation, "Centralized")
+    _reject_faults(faults, robust_agg, "Centralized")
     all_idx = setup.all_train_idx
     n = int(all_idx.shape[0])
     train = _cached_centralized_trainer(
@@ -456,10 +627,13 @@ def Distributed(
     seed=0,
     sequential=False,
     participation=1.0,
+    faults=None,
+    robust_agg="mean",
     **_,
 ):
     """One-shot FL with fixed sample-count weights (``tools.py:258-276``)."""
     _reject_partial(participation, "Distributed")
+    _reject_faults(faults, robust_agg, "Distributed")
     stacked, losses = _oneshot_local_phase(
         setup, epoch, batch_size, sequential, seed, lr,
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
@@ -485,6 +659,8 @@ def FedAMW_OneShot(
     seed=0,
     sequential=False,
     participation=1.0,
+    faults=None,
+    robust_agg="mean",
     **_,
 ):
     """One long local phase, then ``round`` iterations of mixture-weight
@@ -493,6 +669,7 @@ def FedAMW_OneShot(
     client-0 aliasing bug (weights rescaled by p[0] every iteration) is
     deliberately not reproduced."""
     _reject_partial(participation, "FedAMW_OneShot")
+    _reject_faults(faults, robust_agg, "FedAMW_OneShot")
     stacked, losses = _oneshot_local_phase(
         setup, epoch, batch_size, sequential, seed, lr,
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
@@ -532,9 +709,20 @@ def _round_based(
     resume_from=None,
     server_opt="none",
     server_lr=1.0,
+    faults=None,
+    robust_agg="mean",
 ):
     """Common skeleton of FedAvg/FedProx/FedNova/FedAMW: scan over rounds
     of {local updates -> aggregate -> eval} (``tools.py:337-352``).
+
+    ``faults`` (None | spec string | FaultSpec | FaultPlan) injects
+    deterministic client faults per round (``fedcore.faults``);
+    ``robust_agg`` ("mean" | "median" | "trim:K" | "clip:R" | "+"
+    combinations, ``fedcore.robust``) selects the defense. Both are
+    static trainer configuration; the plan's per-round rows are dynamic
+    scanned inputs, so changing the plan never recompiles. With faults
+    active the result carries ``fault_counts`` (per-round dropped /
+    straggled / corrupted / quarantined).
 
     Every array is an explicit jit argument — a closure-captured device
     array would be baked into the HLO as a literal constant (hundreds of
@@ -576,6 +764,13 @@ def _round_based(
     n_val = int(setup.X_val.shape[0])
     idx_tup, mask_tup = setup.round_arrays()
 
+    # fault plane: validated/expanded HERE (host-side, cheap) so a bad
+    # spec fails before any compile; the canonical robust spec string
+    # keys the trainer cache so equivalent spellings share a program
+    plan = resolve_fault_plan(faults, rounds, setup.num_clients)
+    faults_on = plan is not None
+    robust_canonical = parse_robust_spec(robust_agg).canonical()
+
     train = _cached_round_trainer(
         setup.model.init, setup.model.apply, setup.task, setup.D,
         setup.num_classes, setup.num_clients, epoch, batch_size,
@@ -583,7 +778,10 @@ def _round_based(
         aggregation, lr_p, val_batch_size, n_val, sequential,
         setup.mesh_devices, verbose, float(participation), _kernel_env(),
         int(start_round), stop, server_opt, float(server_lr),
+        faults_on, robust_canonical,
     )
+    global _LAST_TRAIN_FN
+    _LAST_TRAIN_FN = train
 
     # Host-computed schedule from the Python-float lr: bit-identical to
     # the torch backend's lr_schedule_array path (an in-graph f32
@@ -647,15 +845,19 @@ def _round_based(
                 "uninterrupted one (save res['server_opt'] through the "
                 "checkpoint for exact resume)", stacklevel=3)
 
+    # the plan rows ride the dispatch like the LR schedule: sliced from
+    # the full horizon, so prefix + resume replays identical faults
+    fault_rows = plan.rows(start_round, stop) if faults_on else None
     if aggregation == "learned":
         args = (seed, setup.X, setup.y, idx_tup, mask_tup,
                 setup.X_val, setup.y_val, setup.X_test, setup.y_test,
                 lrs, p0, setup.sizes, float(mu), float(lam), params0,
-                opt0)
+                opt0, fault_rows)
     else:
         args = (seed, setup.X, setup.y, idx_tup, mask_tup,
                 setup.X_test, setup.y_test, lrs,
-                p0, setup.sizes, float(mu), float(lam), params0, opt0)
+                p0, setup.sizes, float(mu), float(lam), params0, opt0,
+                fault_rows)
 
     if analyze_memory:
         # AOT device-memory report for the WHOLE fused training program
@@ -675,6 +877,19 @@ def _round_based(
 
     metrics = np.asarray(metrics)
     out = result_tuple(metrics[0], metrics[1], metrics[2])
+    if faults_on:
+        # per-round observability (utils.reporting.format_fault_report):
+        # the role counts are plan facts over the real clients
+        # (host-side), quarantined is the runtime verdict from the
+        # non-finite sanitizer (the 4th scanned metric row)
+        valid_np = (np.asarray(setup.sizes) > 0).astype(np.float64)
+        sl = slice(start_round, stop)
+        out["fault_counts"] = {
+            "dropped": (plan.drop[sl] * valid_np).sum(1).astype(int),
+            "straggled": (plan.straggle[sl] * valid_np).sum(1).astype(int),
+            "corrupted": (plan.corrupt[sl] * valid_np).sum(1).astype(int),
+            "quarantined": np.rint(metrics[3]).astype(int),
+        }
     if return_state:
         # final global model + mixture weights + optimizer state, for
         # checkpointing (utils/checkpoint.py); optimizer state travels
@@ -712,6 +927,8 @@ def FedAvg(
     resume_from=None,
     server_opt="none",
     server_lr=1.0,
+    faults=None,
+    robust_agg="mean",
     **_,
 ):
     """Standard FedAvg (``tools.py:329-353``)."""
@@ -725,6 +942,7 @@ def FedAvg(
         start_round=start_round, stop_round=stop_round,
         resume_from=resume_from,
         server_opt=server_opt, server_lr=server_lr,
+        faults=faults, robust_agg=robust_agg,
     )
 
 
@@ -750,6 +968,8 @@ def FedProx(
     resume_from=None,
     server_opt="none",
     server_lr=1.0,
+    faults=None,
+    robust_agg="mean",
     **_,
 ):
     """FedAvg skeleton + proximal term (``tools.py:356-380``)."""
@@ -763,6 +983,7 @@ def FedProx(
         start_round=start_round, stop_round=stop_round,
         resume_from=resume_from,
         server_opt=server_opt, server_lr=server_lr,
+        faults=faults, robust_agg=robust_agg,
     )
 
 
@@ -788,6 +1009,8 @@ def FedNova(
     resume_from=None,
     server_opt="none",
     server_lr=1.0,
+    faults=None,
+    robust_agg="mean",
     **_,
 ):
     """Normalized averaging (``tools.py:383-410``)."""
@@ -801,6 +1024,7 @@ def FedNova(
         start_round=start_round, stop_round=stop_round,
         resume_from=resume_from,
         server_opt=server_opt, server_lr=server_lr,
+        faults=faults, robust_agg=robust_agg,
     )
 
 
@@ -828,27 +1052,32 @@ def FedAMW(
     resume_from=None,
     server_opt="none",
     server_lr=1.0,
+    faults=None,
+    robust_agg="mean",
     **_,
 ):
     """The paper's algorithm (``tools.py:413-463``): ridge-regularized
     local training; per round, ``round`` epochs of mixture-weight SGD
     (momentum 0.9) on the pooled validation set over cached per-client
-    logits; aggregate with the learned, unconstrained p."""
-    if participation < 1.0:
-        raise ValueError(
-            "FedAMW assumes full participation (the learned mixture "
-            "weights are fit over every client's cached logits, "
-            "tools.py:435-453); partial participation is supported for "
-            "FedAvg/FedProx/FedNova only"
-        )
+    logits; aggregate with the learned, unconstrained p.
+
+    Extension beyond the reference: partial participation and the
+    fault plane are accepted — the p-solver runs masked over the
+    present clients each round (an absent/quarantined client's mixture
+    weight and momentum are zeroed, so it carries exactly zero learned
+    mass and re-earns weight on return; under FEDAMW_P_GUARD=simplex
+    the projection runs over the present subset too, keeping p on the
+    masked simplex) and the round aggregates with the masked p."""
     return _round_based(
         setup, "learned", lr, epoch, batch_size, round,
         mu if prox else 0.0, lambda_reg if lambda_reg_if else 0.0,
         lr_p=lr_p, val_batch_size=val_batch_size,
         seed=seed, lr_mode=lr_mode, sequential=sequential,
         verbose=verbose, return_state=return_state,
+        participation=participation,
         analyze_memory=analyze_memory,
         start_round=start_round, stop_round=stop_round,
         resume_from=resume_from,
         server_opt=server_opt, server_lr=server_lr,
+        faults=faults, robust_agg=robust_agg,
     )

@@ -208,3 +208,10 @@ class ExperimentConfig:
     # and the reference's actual compounding LR decay (x1, x0.1, x0.001).
     sequential_clients: bool = False
     lr_schedule: str = "reference"  # 'reference' (x0.001 tail) | 'paper' (x0.01)
+    # Fault-tolerance plane (extension; the reference assumes clean,
+    # full-report rounds). `faults` is a fedcore.faults.FaultSpec
+    # string ('drop=0.1,corrupt=0.05:nan,seed=7'); `robust_agg` a
+    # fedcore.robust spec ('mean' | 'median' | 'trim:K' | 'clip:R',
+    # '+'-combinable). None/'mean' keep the reference's exact rounds.
+    faults: str | None = None
+    robust_agg: str = "mean"

@@ -7,15 +7,36 @@ from .aggregate import (
 )
 from .client import make_bucketed_round, make_client_round, make_local_update
 from .evaluate import make_evaluator
+from .faults import FaultPlan, FaultSpec, inject_fault_row, resolve_fault_plan
+from .robust import (
+    RobustSpec,
+    clip_update_norms,
+    coordinatewise_median,
+    coordinatewise_trimmed_mean,
+    make_robust_aggregator,
+    parse_robust_spec,
+    sanitize_updates,
+)
 
 __all__ = [
+    "FaultPlan",
+    "FaultSpec",
+    "RobustSpec",
     "client_logits",
+    "clip_update_norms",
+    "coordinatewise_median",
+    "coordinatewise_trimmed_mean",
     "fednova_effective_weights",
-    "make_p_solver",
-    "participation_weights",
-    "weighted_average",
+    "inject_fault_row",
     "make_bucketed_round",
     "make_client_round",
     "make_local_update",
     "make_evaluator",
+    "make_p_solver",
+    "make_robust_aggregator",
+    "parse_robust_spec",
+    "participation_weights",
+    "resolve_fault_plan",
+    "sanitize_updates",
+    "weighted_average",
 ]

@@ -1,0 +1,259 @@
+"""Deterministic fault injection for the round-based trainers.
+
+A production federated round never sees the clean world the reference
+assumes (every client reports a finite update every round,
+``tools.py:340``): clients drop out, straggle, and occasionally report
+garbage. This module simulates all three **deterministically** and
+**shape-stably** so the whole fault plane lives inside the existing
+``jit`` + ``lax.scan`` round trainer with zero recompiles:
+
+- a :class:`FaultSpec` (parsed from the CLI string syntax below) is
+  expanded once, host-side, into a :class:`FaultPlan` — dense
+  ``(rounds, num_clients)`` mask/multiplier arrays seeded by the spec,
+  so the same seed always yields the same plan;
+- the per-round plan rows ride the round scan as ordinary scanned
+  inputs (like the LR schedule), so a different plan reuses the same
+  compiled program (pinned in ``tests/test_faults.py``);
+- :func:`inject_fault_row` applies one round's row to the stacked
+  client updates *in transit* — after local training, before
+  aggregation — which is where real corruption happens (the client
+  computed something; the server received something else).
+
+Fault kinds (mutually exclusive per ``(round, client)`` cell, sampled
+from one uniform draw):
+
+- **dropped**: the report never arrives. The client is excluded from
+  aggregation and its weight renormalized over the survivors
+  (``aggregate.participation_weights``).
+- **straggling**: the client was cut off mid-work; its *update*
+  (delta from the incoming global params) is scaled by
+  ``straggle_frac`` in ``(0, 1]``. This is the shape-stable stand-in
+  for truncated local epochs — exact for a single SGD step, an
+  approximation for multi-epoch runs (a FedNova-aware renormalization
+  is a ROADMAP follow-on).
+- **corrupted**: the report is garbage — ``nan``/``inf`` (every
+  coordinate poisoned; caught by the non-finite quarantine in
+  ``fedcore.robust``), ``sign`` (update negated), or ``scale`` (update
+  multiplied by ``corrupt_scale``; the finite modes are what norm
+  clipping and the trimmed-mean/median aggregators defend against).
+
+Spec string syntax (the ``exp.py --faults`` surface)::
+
+    drop=0.1,straggle=0.2:0.5,corrupt=0.05:nan,seed=7
+         ^rate          ^rate ^frac        ^mode[:scale]
+
+Clean clients pass through **bitwise untouched** (the injection is a
+``where`` on the faulty cells only), so a faulty run's surviving
+updates are exactly the clean run's — what makes "the quarantined
+round equals the clean run minus that client" testable at array
+equality.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_CORRUPT_MODES = ("nan", "inf", "sign", "scale")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """Rates and shapes of the faults to inject, plus the plan seed."""
+
+    drop: float = 0.0
+    straggle: float = 0.0
+    straggle_frac: float = 0.5
+    corrupt: float = 0.0
+    corrupt_mode: str = "nan"
+    corrupt_scale: float = 10.0
+    seed: int = 0
+
+    def __post_init__(self):
+        for name in ("drop", "straggle", "corrupt"):
+            r = getattr(self, name)
+            if not 0.0 <= r <= 1.0:
+                raise ValueError(
+                    f"fault rate {name}={r} must be in [0, 1]")
+        total = self.drop + self.straggle + self.corrupt
+        if total > 1.0:
+            raise ValueError(
+                f"fault rates must sum to <= 1 (a client is at most one "
+                f"of dropped/straggling/corrupted per round), got "
+                f"drop+straggle+corrupt={total}")
+        if not 0.0 < self.straggle_frac <= 1.0:
+            raise ValueError(
+                f"straggle_frac={self.straggle_frac} must be in (0, 1] "
+                "(the fraction of the local update that survives)")
+        if self.corrupt_mode not in _CORRUPT_MODES:
+            raise ValueError(
+                f"corrupt_mode={self.corrupt_mode!r}; expected one of "
+                f"{_CORRUPT_MODES}")
+        if not np.isfinite(self.corrupt_scale):
+            raise ValueError(
+                f"corrupt_scale={self.corrupt_scale} must be finite "
+                "(use corrupt_mode='nan'/'inf' for non-finite poison)")
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSpec":
+        """Parse the CLI spec syntax (module docstring). Unknown keys
+        and malformed values raise ``ValueError`` naming the token, so
+        a typo fails at the flag boundary, not mid-run."""
+        kw: dict = {}
+        for token in text.split(","):
+            token = token.strip()
+            if not token:
+                continue
+            if "=" not in token:
+                raise ValueError(
+                    f"fault spec token {token!r} is not key=value "
+                    "(expected e.g. 'drop=0.1,corrupt=0.05:nan,seed=7')")
+            key, val = token.split("=", 1)
+            key = key.strip().lower()
+            if key not in ("drop", "straggle", "corrupt", "seed"):
+                # raised OUTSIDE the conversion guard below: routing
+                # it by exception-text matching would misfire on user
+                # values that happen to contain the same words
+                raise ValueError(
+                    f"unknown fault spec key {key!r} (expected "
+                    "drop/straggle/corrupt/seed)")
+            try:
+                if key == "drop":
+                    kw["drop"] = float(val)
+                elif key == "straggle":
+                    rate, _, frac = val.partition(":")
+                    kw["straggle"] = float(rate)
+                    if frac:
+                        kw["straggle_frac"] = float(frac)
+                elif key == "corrupt":
+                    rate, _, rest = val.partition(":")
+                    kw["corrupt"] = float(rate)
+                    if rest:
+                        mode, _, scale = rest.partition(":")
+                        kw["corrupt_mode"] = mode.strip().lower()
+                        if scale:
+                            kw["corrupt_scale"] = float(scale)
+                else:
+                    kw["seed"] = int(val)
+            except ValueError as e:
+                raise ValueError(
+                    f"fault spec token {token!r}: {e}") from None
+        return cls(**kw)
+
+
+class FaultPlan:
+    """Dense per-``(round, client)`` fault schedule.
+
+    All arrays are host-side ``(rounds, num_clients)`` float32:
+    ``drop``/``straggle``/``corrupt`` are 0/1 role masks (mutually
+    exclusive), ``scale`` the delta multiplier (1 for clean cells),
+    ``poison`` the 0/1 full-poison mask and ``fill`` its NaN/Inf value
+    (0 elsewhere). Construction is deterministic in the spec: the same
+    ``FaultSpec`` always builds the identical plan.
+    """
+
+    def __init__(self, drop, straggle, corrupt, scale, poison, fill):
+        arrs = [np.asarray(a, np.float32)
+                for a in (drop, straggle, corrupt, scale, poison, fill)]
+        shape = arrs[0].shape
+        if len(shape) != 2 or any(a.shape != shape for a in arrs):
+            raise ValueError(
+                f"FaultPlan arrays must share one (rounds, num_clients) "
+                f"shape, got {[a.shape for a in arrs]}")
+        self.drop, self.straggle, self.corrupt = arrs[:3]
+        self.scale, self.poison, self.fill = arrs[3:]
+        self.rounds, self.num_clients = shape
+
+    @classmethod
+    def build(cls, spec: FaultSpec, rounds: int,
+              num_clients: int) -> "FaultPlan":
+        """Expand a spec over the full horizon. One uniform draw per
+        cell assigns at most one role (drop wins over straggle over
+        corrupt), so rates compose without overlap."""
+        rs = np.random.RandomState(spec.seed)
+        u = rs.random_sample((rounds, num_clients))
+        drop = u < spec.drop
+        straggle = ~drop & (u < spec.drop + spec.straggle)
+        corrupt = (~drop & ~straggle
+                   & (u < spec.drop + spec.straggle + spec.corrupt))
+        scale = np.ones((rounds, num_clients), np.float32)
+        scale[straggle] = spec.straggle_frac
+        poison = np.zeros_like(scale)
+        fill = np.zeros_like(scale)
+        if spec.corrupt_mode == "sign":
+            scale[corrupt] = -1.0
+        elif spec.corrupt_mode == "scale":
+            scale[corrupt] = spec.corrupt_scale
+        else:
+            poison[corrupt] = 1.0
+            fill[corrupt] = (np.nan if spec.corrupt_mode == "nan"
+                             else np.inf)
+        return cls(drop, straggle, corrupt, scale, poison, fill)
+
+    def rows(self, start: int, stop: int):
+        """The in-graph slice: ``(drop, scale, poison, fill)`` device
+        arrays for rounds ``[start, stop)``, shaped to ride the round
+        scan as ordinary per-round inputs (the role masks
+        ``straggle``/``corrupt`` are reporting-only and stay host-side).
+        Sliced from the full horizon exactly like the LR schedule, so
+        prefix + resume replays the identical faults."""
+        sl = slice(start, stop)
+        return tuple(jnp.asarray(a[sl]) for a in
+                     (self.drop, self.scale, self.poison, self.fill))
+
+
+def resolve_fault_plan(faults, rounds: int, num_clients: int):
+    """Normalize the ``faults=`` argument the algorithms accept: None
+    (clean — the default graph, bit-identical to a build without this
+    module), a spec string, a :class:`FaultSpec`, or a prebuilt
+    :class:`FaultPlan` (shape-checked against this run)."""
+    if faults is None:
+        return None
+    if isinstance(faults, str):
+        faults = FaultSpec.parse(faults)
+    if isinstance(faults, FaultSpec):
+        return FaultPlan.build(faults, rounds, num_clients)
+    if isinstance(faults, FaultPlan):
+        if (faults.rounds, faults.num_clients) != (rounds, num_clients):
+            raise ValueError(
+                f"FaultPlan is ({faults.rounds}, {faults.num_clients}) "
+                f"but this run is ({rounds}, {num_clients}) "
+                "(rounds, clients); rebuild the plan for this horizon")
+        return faults
+    raise TypeError(
+        f"faults must be None, a spec string, a FaultSpec or a "
+        f"FaultPlan, got {type(faults).__name__}")
+
+
+def _bcast(v, ndim: int):
+    """Broadcast a per-client ``(J,)`` vector against ``(J, ...)``
+    leaves."""
+    return v.reshape(v.shape + (1,) * (ndim - 1))
+
+
+def inject_fault_row(params, stacked, losses, scale_t, poison_t, fill_t):
+    """Apply one plan row to a round's reported updates (traced).
+
+    Faulty cells become ``global + scale * (update - global)`` (or the
+    poison fill value on every coordinate); clean cells pass through
+    **bitwise** via the outer ``where`` — re-deriving ``g + (s - g)``
+    would perturb clean clients by float rounding and break the
+    faulty-run == clean-run-minus-faulty-client equalities the test
+    suite pins. A poisoned client's reported loss is poisoned too (a
+    client that reports NaN weights does not report an honest loss);
+    the quarantine masks it back out of the loss average.
+    """
+    faithful = (scale_t == 1.0) & (poison_t == 0.0)
+
+    def leaf(s, g):
+        d = jnp.where(_bcast(poison_t, s.ndim) > 0,
+                      _bcast(fill_t, s.ndim),
+                      (s - g) * _bcast(scale_t, s.ndim))
+        return jnp.where(_bcast(faithful, s.ndim), s, g + d)
+
+    stacked = jax.tree.map(leaf, stacked, params)
+    losses = jnp.where(poison_t > 0, fill_t, losses)
+    return stacked, losses

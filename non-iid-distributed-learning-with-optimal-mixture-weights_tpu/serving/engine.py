@@ -209,10 +209,21 @@ class ServingEngine:
         engine serves RAW inputs; otherwise it serves pre-mapped
         features (or pass ``rff=(W, b)`` explicitly). For a run trained
         with ``prepare_setup(feature_dtype=...)`` pass the same dtype
-        here — the checkpoint does not record it."""
-        from ..utils.checkpoint import load_checkpoint
+        here — the checkpoint does not record it.
+
+        A damaged checkpoint (truncated pickle, broken orbax tree, or
+        a state with no ``params``) surfaces as a
+        ``utils.checkpoint.CheckpointError`` naming the offending path
+        — the serving box's operator gets "which file is broken", not
+        a storage-layer traceback mid-construction."""
+        from ..utils.checkpoint import CheckpointError, load_checkpoint
 
         state = load_checkpoint(path)
+        if "params" not in state:
+            raise CheckpointError(
+                path, "state has no 'params' entry (not a "
+                "save_checkpoint layout?); found keys "
+                f"{sorted(state)!r}")
         if rff is None and "rff_W" in state and "rff_b" in state:
             rff = (state["rff_W"], state["rff_b"])
         if feature_dtype is None and "feature_dtype" in state:

@@ -70,6 +70,7 @@ class ServeMetrics:
         self.shed_deadline = 0
         self.shed_overload = 0
         self.shed_shutdown = 0
+        self.retries = 0
         self.queue_depth_peak = 0
         self._t_first = None
         self._t_last = None
@@ -92,6 +93,15 @@ class ServeMetrics:
                 self.shed_shutdown += 1
             else:
                 self.shed_overload += 1
+
+    def record_retry(self) -> None:
+        """One transient engine-dispatch failure absorbed by the
+        service's bounded-backoff retry (``service._serve_batch``).
+        A nonzero steady rate is the operator's early-warning signal
+        that the engine's backend is flapping even while every request
+        still succeeds."""
+        with self._lock:
+            self.retries += 1
 
     def record_batch(self, n_requests: int, n_rows: int,
                      latencies: list[float],
@@ -120,6 +130,7 @@ class ServeMetrics:
                 "shed_deadline": self.shed_deadline,
                 "shed_overload": self.shed_overload,
                 "shed_shutdown": self.shed_shutdown,
+                "retries": self.retries,
                 "queue_depth_peak": self.queue_depth_peak,
                 "mean_batch_rows": (
                     round(self.rows_served / self.batches, 2)

@@ -45,6 +45,31 @@ class ServiceStopped(RuntimeError):
     longer deadline does not misread a deliberate stop as one."""
 
 
+#: Lower-cased substrings marking an engine-dispatch failure as
+#: transient (worth a bounded retry): the gRPC/absl status families a
+#: remote-attached accelerator surfaces when the tunnel hiccups, plus
+#: generic connectivity wording. Deliberately NOT any bare
+#: RuntimeError — a programming error must fail fast, every time.
+_TRANSIENT_MARKERS = (
+    "unavailable", "resource_exhausted", "deadline_exceeded", "aborted",
+    "connection", "socket", "unreachable", "temporarily",
+)
+
+
+def _is_transient(exc: BaseException) -> bool:
+    """Whether an engine dispatch failure is worth retrying: OS-level
+    connectivity errors by type, backend/RPC errors by status wording.
+    Shape/validation errors (``ValueError``/``TypeError``) are
+    permanent by construction — retrying the same malformed batch can
+    only fail the same way, slower."""
+    if isinstance(exc, (ValueError, TypeError)):
+        return False
+    if isinstance(exc, (OSError, ConnectionError)):
+        return True
+    msg = str(exc).lower()
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
 def _resolve(fut: Future, result=None, exc=None) -> None:
     """Resolve a request Future, tolerating caller-side cancellation:
     ``set_result``/``set_exception`` on a cancelled Future raise
@@ -76,10 +101,21 @@ class ServingService:
     """
 
     def __init__(self, engine, max_queue: int = 1024,
-                 max_wait_ms: float = 2.0, metrics: ServeMetrics | None = None):
+                 max_wait_ms: float = 2.0, metrics: ServeMetrics | None = None,
+                 retries: int = 2, retry_backoff_ms: float = 5.0):
+        """``retries``/``retry_backoff_ms``: bounded exponential-backoff
+        retry of TRANSIENT engine-dispatch failures (``_is_transient``;
+        a flapping remote-accelerator tunnel) — at most ``retries``
+        re-dispatches per batch, backoff doubling from
+        ``retry_backoff_ms`` but never sleeping past the earliest live
+        deadline in the batch. Permanent errors (bad shapes, real
+        bugs) still fail every affected future on the first attempt.
+        Retries are counted in ``metrics.snapshot()['retries']``."""
         self.engine = engine
         self.max_queue = int(max_queue)
         self.max_wait = max_wait_ms / 1e3
+        self.retries = int(retries)
+        self.retry_backoff = retry_backoff_ms / 1e3
         self.metrics = metrics if metrics is not None else ServeMetrics()
         self._width = engine.input_dim  # computed once, checked per submit
         self._q: queue.Queue[_Request] = queue.Queue()
@@ -269,23 +305,75 @@ class ServingService:
                     live.append(req)
             if not live:
                 continue
+            self._serve_batch(live)
+
+    def _serve_batch(self, live) -> None:
+        """One micro-batch through the engine, with bounded-backoff
+        retry of transient dispatch failures; every future in ``live``
+        is resolved here (result, deadline, or error) — nothing can
+        strand, whichever way the engine fails."""
+        try:
+            # coalesce INSIDE the guard: mixed feature widths in
+            # one micro-batch raise here, and an escape would kill
+            # the worker thread and strand every queued future
+            X, spans = coalesce([r.x for r in live])
+        except Exception as e:  # batch failure -> every caller told
+            for req in live:
+                _resolve(req.future, exc=e)
+            return
+        attempt = 0
+        while True:
             try:
-                # coalesce INSIDE the guard: mixed feature widths in
-                # one micro-batch raise here, and an escape would kill
-                # the worker thread and strand every queued future
-                X, spans = coalesce([r.x for r in live])
                 outs = split_results(self.engine.predict(X), spans)
-            except Exception as e:  # batch failure -> every caller told
-                for req in live:
-                    _resolve(req.future, exc=e)
-                continue
-            done = time.perf_counter()
-            # metrics BEFORE resolving futures: a caller that waits on
-            # its future and then snapshots must see this batch counted
-            self.metrics.record_batch(
-                n_requests=len(live),
-                n_rows=sum(request_rows(r.x) for r in live),
-                latencies=[done - r.t_submit for r in live],
-                now=done)
-            for req, out in zip(live, outs):
-                _resolve(req.future, result=out)
+                break
+            except Exception as e:
+                if not _is_transient(e) or attempt >= self.retries:
+                    # permanent (or out of budget): fail fast, every
+                    # caller told — same contract as before retries
+                    for req in live:
+                        _resolve(req.future, exc=e)
+                    return
+                attempt += 1
+                self.metrics.record_retry()
+                delay = self.retry_backoff * (2 ** (attempt - 1))
+                now = time.perf_counter()
+                budgets = [r.deadline - now for r in live
+                           if r.deadline is not None]
+                if budgets:
+                    # deadline-respecting: sleep at most HALF the
+                    # earliest remaining budget — sleeping the full
+                    # backoff (or exactly up to the deadline) would
+                    # guarantee the tightest-deadline request expires
+                    # without its retry ever being attempted, while
+                    # half-the-budget always leaves room for one more
+                    # dispatch and still paces (no busy spin)
+                    delay = min(delay, max(0.0, min(budgets) / 2))
+                if delay:
+                    time.sleep(delay)
+                now = time.perf_counter()
+                # partition by predicate, NOT by `in`-membership: the
+                # dataclass __eq__ would compare the numpy payloads
+                expired = [r for r in live
+                           if r.deadline is not None and now > r.deadline]
+                if expired:
+                    for req in expired:
+                        self.metrics.record_shed("deadline")
+                        _resolve(req.future, exc=DeadlineExceeded(
+                            "expired during engine-dispatch retries"))
+                    live = [r for r in live
+                            if r.deadline is None or now <= r.deadline]
+                    if not live:
+                        return
+                    # already coalesced once above, so this re-coalesce
+                    # of a subset cannot raise
+                    X, spans = coalesce([r.x for r in live])
+        done = time.perf_counter()
+        # metrics BEFORE resolving futures: a caller that waits on
+        # its future and then snapshots must see this batch counted
+        self.metrics.record_batch(
+            n_requests=len(live),
+            n_rows=sum(request_rows(r.x) for r in live),
+            latencies=[done - r.t_submit for r in live],
+            now=done)
+        for req, out in zip(live, outs):
+            _resolve(req.future, result=out)

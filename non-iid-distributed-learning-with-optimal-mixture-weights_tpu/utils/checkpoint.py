@@ -18,6 +18,21 @@ from typing import Any
 import numpy as np
 
 
+class CheckpointError(RuntimeError):
+    """A checkpoint exists at ``path`` but could not be restored —
+    truncated/corrupt pickle, a broken orbax tree, or a state dict
+    missing required entries. Typed (instead of whatever bare
+    traceback the storage layer happened to raise) so serving and
+    resume flows can tell "this checkpoint is damaged, name the file"
+    apart from programming errors; a missing checkpoint stays
+    ``FileNotFoundError``."""
+
+    def __init__(self, path: str, detail: str):
+        self.path = path
+        super().__init__(
+            f"checkpoint at {path} could not be loaded: {detail}")
+
+
 def _to_host(tree):
     import jax
 
@@ -105,23 +120,40 @@ def save_checkpoint(path: str, params, p=None, round_idx: int | None = None,
 
 def load_checkpoint(path: str) -> dict:
     """Load a checkpoint written by :func:`save_checkpoint` (either
-    layout)."""
+    layout).
+
+    A checkpoint that EXISTS but cannot be restored — truncated or
+    corrupt ``state.pkl``, broken orbax tree — raises
+    :class:`CheckpointError` naming the offending file instead of the
+    storage layer's bare traceback (an ``EOFError`` with no path is
+    useless on a box serving dozens of checkpoints); a missing
+    checkpoint stays ``FileNotFoundError``.
+    """
     orbax_dir = os.path.join(path, "orbax")
     if os.path.isdir(orbax_dir):
-        import orbax.checkpoint as ocp
-
-        with ocp.PyTreeCheckpointer() as ckptr:
-            return ckptr.restore(os.path.abspath(orbax_dir))
+        return _restore_orbax(orbax_dir)
     pkl = os.path.join(path, "state.pkl")
     if os.path.exists(pkl):
-        with open(pkl, "rb") as f:
-            return pickle.load(f)
+        try:
+            with open(pkl, "rb") as f:
+                return pickle.load(f)
+        except Exception as e:  # truncated write, corrupt bytes, ...
+            raise CheckpointError(
+                pkl, f"{type(e).__name__}: {e}") from e
     if os.path.isdir(path) and os.path.exists(
         os.path.join(path, "_CHECKPOINT_METADATA")
     ):
         # a bare orbax dir was passed directly
-        import orbax.checkpoint as ocp
-
-        with ocp.PyTreeCheckpointer() as ckptr:
-            return ckptr.restore(os.path.abspath(path))
+        return _restore_orbax(path)
     raise FileNotFoundError(f"no checkpoint under {path}")
+
+
+def _restore_orbax(orbax_dir: str) -> dict:
+    import orbax.checkpoint as ocp
+
+    try:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            return ckptr.restore(os.path.abspath(orbax_dir))
+    except Exception as e:  # partial tree from an interrupted save, ...
+        raise CheckpointError(
+            orbax_dir, f"{type(e).__name__}: {e}") from e

@@ -57,6 +57,37 @@ def print_time(matrix) -> str:
     return "".join(out)
 
 
+def fault_summary(fault_counts: dict) -> dict:
+    """Aggregate a ``fault_counts`` record (the per-round dropped /
+    straggled / corrupted / quarantined vectors a faulted run's result
+    carries, ``algorithms.core._round_based``) into run totals:
+    per-kind totals, the worst single round, and how many rounds saw
+    any fault at all."""
+    kinds = ("dropped", "straggled", "corrupted", "quarantined")
+    arrs = {k: np.asarray(fault_counts[k], dtype=int) for k in kinds}
+    any_fault = sum(arrs[k] for k in ("dropped", "straggled", "corrupted"))
+    return {
+        **{f"total_{k}": int(arrs[k].sum()) for k in kinds},
+        "rounds": int(next(iter(arrs.values())).shape[0]),
+        "rounds_with_faults": int(np.count_nonzero(any_fault)),
+        "worst_round_faults": int(any_fault.max()) if any_fault.size else 0,
+    }
+
+
+def format_fault_report(name: str, fault_counts: dict) -> str:
+    """One human-readable line per algorithm for the driver's stdout
+    (``exp.py`` prints this after each faulted run): totals plus the
+    invariant the quarantine is supposed to hold — every non-finite
+    report caught (quarantined >= corrupted for nan/inf modes)."""
+    s = fault_summary(fault_counts)
+    return (f"{name} faults: {s['total_dropped']} dropped, "
+            f"{s['total_straggled']} straggled, "
+            f"{s['total_corrupted']} corrupted, "
+            f"{s['total_quarantined']} quarantined over "
+            f"{s['rounds_with_faults']}/{s['rounds']} rounds "
+            f"(worst round: {s['worst_round_faults']} faulty clients)")
+
+
 def load_results(path: str) -> dict:
     """Load an ``exp1_{dataset}.pkl`` result dict (driver schema)."""
     with open(path, "rb") as f:

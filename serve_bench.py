@@ -146,24 +146,16 @@ def mixed_stream(engine, n_requests: int, max_wait_ms: float, rng) -> dict:
 
 
 def main():
-    platforms = os.environ.get("JAX_PLATFORMS", "")
-    if platforms:
-        # same dance as bench.py: the container's sitecustomize
-        # force-registers the axon TPU plugin, so the env var must be
-        # re-applied to the config before the first backend query
-        import jax
+    # shared prologue with bench.py (bench_common): re-apply
+    # JAX_PLATFORMS over the container's sitecustomize, then the
+    # BENCH_STRICT_TPU certification abort on the RESOLVED backend
+    from bench_common import reapply_jax_platforms, strict_tpu_abort
 
-        jax.config.update("jax_platforms", platforms)
+    reapply_jax_platforms()
     import jax
 
     platform = jax.default_backend()
-    if os.environ.get("BENCH_STRICT_TPU"):
-        from fedamw_tpu.fedcore.client import _TPU_BACKENDS
-
-        if platform not in _TPU_BACKENDS:
-            print(f"# serve_bench aborted: BENCH_STRICT_TPU set but the "
-                  f"resolved backend is {platform!r}", file=sys.stderr)
-            raise SystemExit(1)
+    strict_tpu_abort("serve_bench", platform)
 
     from fedamw_tpu.serving import ServingEngine
 

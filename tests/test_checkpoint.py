@@ -72,6 +72,73 @@ def test_layout_switch_never_shadows_fresh_state(tmp_path, monkeypatch):
                                   new["w"])
 
 
+def test_corrupt_pickle_raises_typed_error_with_path(tmp_path):
+    """A truncated/corrupt state.pkl raises CheckpointError naming the
+    offending file — not the storage layer's bare EOFError/
+    UnpicklingError (useless on a box serving dozens of checkpoints).
+    A missing checkpoint stays FileNotFoundError."""
+    import pickle
+
+    import pytest
+
+    from fedamw_tpu.utils.checkpoint import CheckpointError
+
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    good = pickle.dumps({"params": {"w": np.zeros((2, 2), np.float32)}})
+    (ck / "state.pkl").write_bytes(good[: len(good) // 2])  # truncated
+    with pytest.raises(CheckpointError, match="state.pkl"):
+        load_checkpoint(str(ck))
+    try:
+        load_checkpoint(str(ck))
+    except CheckpointError as e:
+        assert e.path.endswith("state.pkl")
+
+    (ck / "state.pkl").write_bytes(b"\x80garbage not a pickle")
+    with pytest.raises(CheckpointError):
+        load_checkpoint(str(ck))
+
+    with pytest.raises(FileNotFoundError):
+        load_checkpoint(str(tmp_path / "nowhere"))
+
+
+def test_corrupt_orbax_tree_raises_typed_error(tmp_path):
+    """A half-written orbax layout (interrupted save) is a typed
+    CheckpointError too, and it names the orbax dir."""
+    import pytest
+
+    from fedamw_tpu.utils.checkpoint import CheckpointError
+
+    ck = tmp_path / "ck"
+    (ck / "orbax").mkdir(parents=True)  # empty dir: no valid tree
+    with pytest.raises(CheckpointError, match="orbax"):
+        load_checkpoint(str(ck))
+
+
+def test_serving_engine_surfaces_checkpoint_error(tmp_path):
+    """ServingEngine.load propagates the typed error for a damaged
+    checkpoint and raises its own CheckpointError for a state with no
+    'params' — the operator gets 'which file is broken', never a
+    KeyError mid-construction."""
+    import pickle
+
+    import pytest
+
+    from fedamw_tpu.serving import ServingEngine
+    from fedamw_tpu.utils.checkpoint import CheckpointError
+
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    (ck / "state.pkl").write_bytes(b"not a pickle at all")
+    with pytest.raises(CheckpointError, match="state.pkl"):
+        ServingEngine.load(str(ck))
+
+    with open(ck / "state.pkl", "wb") as f:
+        pickle.dump({"p": np.ones(3, np.float32)}, f)  # no 'params'
+    with pytest.raises(CheckpointError, match="params"):
+        ServingEngine.load(str(ck))
+
+
 def test_fedamw_returns_learned_p():
     ds = load_dataset("digits", num_partitions=6, alpha=0.5)
     setup = prepare_setup(ds, kernel_type="linear", seed=3,

@@ -1,0 +1,412 @@
+"""The fault plane (fedcore.faults) + robust aggregation (fedcore.robust).
+
+Load-bearing contracts (ISSUE 2 acceptance):
+
+- same seed => identical FaultPlan (deterministic injection);
+- faults=None is the untouched default graph (pinned upstream by the
+  oracle-regression suite); a ZERO-RATE spec routes through the fault
+  graph and still reproduces the clean params/eval metrics bitwise;
+- a NaN/Inf-corrupted client is quarantined and the run equals the same
+  run with that client cleanly dropped — array-equal, not approximate;
+- an all-faulty round leaves the global model unchanged;
+- FedAMW accepts partial participation: the p-solver runs masked, the
+  learned p carries exactly zero mass on absent/quarantined clients,
+  and under FEDAMW_P_GUARD=simplex p stays on the MASKED simplex;
+- fault injection adds no recompiles to the round trainer (plan rows
+  are scanned inputs; jit cache counter pinned, same mechanism as
+  tests/test_serve_contract.py).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from fedamw_tpu.algorithms import (FedAMW, FedAMW_OneShot, FedAvg,
+                                   FedNova, core, prepare_setup)
+from fedamw_tpu.data import load_dataset
+from fedamw_tpu.fedcore.faults import (FaultPlan, FaultSpec,
+                                       resolve_fault_plan)
+from fedamw_tpu.fedcore.robust import (RobustSpec, clip_update_norms,
+                                       coordinatewise_median,
+                                       coordinatewise_trimmed_mean,
+                                       parse_robust_spec,
+                                       sanitize_updates)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(scope="module")
+def setup8():
+    ds = load_dataset("digits", num_partitions=8, alpha=0.5)
+    return prepare_setup(ds, kernel_type="linear", seed=3,
+                         rng=np.random.RandomState(3))
+
+
+KW = dict(lr=0.5, epoch=1, round=3, seed=0, lr_mode="constant")
+AMW_KW = dict(**KW, lambda_reg=1e-4, lr_p=1e-3)
+
+
+def target_plan(R, J, kind, j, frac=0.5, fill=np.nan):
+    """A plan hitting exactly client ``j`` every round with one fault
+    kind — the surgical tool the equivalence tests need (spec-built
+    plans hit random clients)."""
+    z = np.zeros((R, J), np.float32)
+    drop, straggle, corrupt = z.copy(), z.copy(), z.copy()
+    scale = np.ones((R, J), np.float32)
+    poison, fillm = z.copy(), z.copy()
+    if kind == "drop":
+        drop[:, j] = 1
+    elif kind == "straggle":
+        straggle[:, j] = 1
+        scale[:, j] = frac
+    elif kind == "sign":
+        corrupt[:, j] = 1
+        scale[:, j] = -1.0
+    else:  # poison (nan/inf)
+        corrupt[:, j] = 1
+        poison[:, j] = 1
+        fillm[:, j] = fill
+    return FaultPlan(drop, straggle, corrupt, scale, poison, fillm)
+
+
+# -- plan determinism and spec parsing --------------------------------
+
+def test_same_seed_identical_plan():
+    spec = FaultSpec(drop=0.2, straggle=0.1, corrupt=0.15,
+                     corrupt_mode="nan", seed=11)
+    a = FaultPlan.build(spec, rounds=20, num_clients=16)
+    b = FaultPlan.build(spec, rounds=20, num_clients=16)
+    for name in ("drop", "straggle", "corrupt", "scale", "poison",
+                 "fill"):
+        np.testing.assert_array_equal(
+            getattr(a, name), getattr(b, name), err_msg=name)
+    # and a different seed actually moves the plan
+    c = FaultPlan.build(dataclasses.replace(spec, seed=12), 20, 16)
+    assert not np.array_equal(a.drop, c.drop)
+
+
+def test_plan_roles_are_exclusive_and_rate_shaped():
+    spec = FaultSpec(drop=0.3, straggle=0.3, corrupt=0.3, seed=0)
+    plan = FaultPlan.build(spec, rounds=50, num_clients=40)
+    total = plan.drop + plan.straggle + plan.corrupt
+    assert total.max() <= 1.0  # one role per (round, client) cell
+    # LLN at n=2000 cells: each empirical rate lands near 0.3
+    for m in (plan.drop, plan.straggle, plan.corrupt):
+        assert 0.25 < m.mean() < 0.35
+
+
+def test_spec_parse_full_syntax():
+    s = FaultSpec.parse("drop=0.1, straggle=0.2:0.25, "
+                        "corrupt=0.05:scale:7.5, seed=9")
+    assert s == FaultSpec(drop=0.1, straggle=0.2, straggle_frac=0.25,
+                          corrupt=0.05, corrupt_mode="scale",
+                          corrupt_scale=7.5, seed=9)
+
+
+@pytest.mark.parametrize("bad", [
+    "drop=1.5", "drop=0.6,straggle=0.6", "straggle=0.1:0",
+    "corrupt=0.1:bogus", "corrupt=0.1:scale:inf", "typo=1",
+    "drop", "drop=abc",
+])
+def test_spec_parse_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        FaultSpec.parse(bad)
+
+
+def test_spec_parse_errors_name_the_token():
+    with pytest.raises(ValueError, match="token 'drop=unknown'"):
+        # a value containing 'unknown' must still get the token-naming
+        # wrapper, not be misrouted as an unknown-key error
+        FaultSpec.parse("drop=unknown")
+    with pytest.raises(ValueError, match="unknown fault spec key"):
+        FaultSpec.parse("frobnicate=1")
+
+
+def test_resolve_rejects_mismatched_plan():
+    plan = FaultPlan.build(FaultSpec(drop=0.1), rounds=4, num_clients=8)
+    with pytest.raises(ValueError, match="horizon"):
+        resolve_fault_plan(plan, rounds=5, num_clients=8)
+    assert resolve_fault_plan(None, 5, 8) is None
+
+
+# -- robust primitives ------------------------------------------------
+
+def test_sanitize_quarantines_nonfinite():
+    g = {"w": np.zeros((3, 2), np.float32)}
+    stacked = {"w": np.stack([np.full((3, 2), 1.0, np.float32),
+                              np.full((3, 2), np.nan, np.float32),
+                              np.full((3, 2), 2.0, np.float32)])}
+    losses = np.asarray([0.5, 0.1, np.inf], np.float32)
+    clean, losses_c, ok = sanitize_updates(g, stacked, losses)
+    np.testing.assert_array_equal(np.asarray(ok), [1.0, 0.0, 0.0])
+    clean_w = np.asarray(clean["w"])
+    np.testing.assert_array_equal(clean_w[0], 1.0)  # untouched
+    np.testing.assert_array_equal(clean_w[1], 0.0)  # -> global params
+    # a quarantined client is excluded WHOLESALE: client 1's loss was
+    # finite, but its params were poisoned, so its loss is zeroed too
+    np.testing.assert_array_equal(np.asarray(losses_c), [0.5, 0.0, 0.0])
+
+
+def test_clip_update_norms_bounds_only_offenders():
+    g = {"w": np.zeros((1, 4), np.float32)}
+    stacked = {"w": np.stack([np.asarray([[3.0, 4.0, 0, 0]], np.float32),
+                              np.asarray([[0.3, 0.4, 0, 0]], np.float32)])}
+    out = np.asarray(clip_update_norms(g, stacked, 1.0)["w"])
+    np.testing.assert_allclose(np.linalg.norm(out[0]), 1.0, rtol=1e-6)
+    np.testing.assert_array_equal(out[1], stacked["w"][1])  # compliant
+
+
+def test_coordinatewise_median_masks_absent():
+    x = {"w": np.asarray([[1.0], [100.0], [2.0], [3.0]], np.float32)}
+    present = np.asarray([1.0, 0.0, 1.0, 1.0], np.float32)
+    out = float(np.asarray(coordinatewise_median(x, present)["w"])[0])
+    assert out == 2.0  # median of {1, 2, 3}; the absent 100 never votes
+
+
+def test_trimmed_mean_drops_extremes_and_falls_back():
+    vals = np.asarray([[v] for v in (0.0, 1.0, 2.0, 3.0, 100.0)],
+                      np.float32)
+    x = {"w": vals}
+    present = np.ones(5, np.float32)
+    out = float(np.asarray(
+        coordinatewise_trimmed_mean(x, present, 1)["w"])[0])
+    np.testing.assert_allclose(out, 2.0)  # mean of {1, 2, 3}
+    # 2 present clients cannot trim 1 from each end -> masked mean
+    present2 = np.asarray([1, 1, 0, 0, 0], np.float32)
+    out2 = float(np.asarray(
+        coordinatewise_trimmed_mean(x, present2, 1)["w"])[0])
+    np.testing.assert_allclose(out2, 0.5)
+
+
+@pytest.mark.parametrize("spec, want", [
+    ("mean", RobustSpec()),
+    ("median", RobustSpec(agg="median")),
+    ("trim:2", RobustSpec(agg="trim", trim=2)),
+    ("clip:5", RobustSpec(clip=5.0)),
+    ("clip:5+trim:1", RobustSpec(agg="trim", trim=1, clip=5.0)),
+    ("CLIP:2.5 + median", RobustSpec(agg="median", clip=2.5)),
+])
+def test_parse_robust_spec(spec, want):
+    assert parse_robust_spec(spec) == want
+
+
+@pytest.mark.parametrize("bad", ["trim", "trim:0", "clip:0", "clip:nan",
+                                 "clip:inf", "median+trim:1", "krum",
+                                 "median+mean", "trim:2+mean",
+                                 "clip:5+clip:0.1"])
+def test_parse_robust_spec_rejects(bad):
+    """Includes the silent-fallback spellings: 'median+mean' must not
+    quietly run the plain average the user opted out of, and duplicate
+    clip radii must not last-win."""
+    with pytest.raises(ValueError):
+        parse_robust_spec(bad)
+
+
+# -- end-to-end: injection, quarantine, equivalences ------------------
+
+def test_zero_rate_spec_matches_clean_run(setup8):
+    clean = FedAvg(setup8, return_state=True, **KW)
+    zero = FedAvg(setup8, faults="drop=0.0,seed=0", return_state=True,
+                  **KW)
+    # the fault graph with an all-clean plan reproduces the clean run:
+    # params and eval metrics bitwise (clean clients pass through the
+    # injection untouched via `where`); train_loss to float tolerance
+    # (its weight rescale fuses into the reduction differently)
+    np.testing.assert_array_equal(np.asarray(zero["params"]["w"]),
+                                  np.asarray(clean["params"]["w"]))
+    np.testing.assert_array_equal(zero["test_acc"], clean["test_acc"])
+    np.testing.assert_array_equal(zero["test_loss"], clean["test_loss"])
+    np.testing.assert_allclose(zero["train_loss"], clean["train_loss"],
+                               rtol=1e-5)
+    assert all(v.sum() == 0 for v in zero["fault_counts"].values())
+
+
+@pytest.mark.parametrize("algo, kw", [(FedAvg, KW), (FedAMW, AMW_KW)])
+def test_nan_client_quarantined_equals_clean_drop(setup8, algo, kw):
+    """The headline robustness contract: a NaN-corrupted client is
+    quarantined, the run stays finite, and every array the run
+    produces equals the same run with that client cleanly dropped —
+    quarantine IS exclusion, not approximation."""
+    R, J = KW["round"], setup8.num_clients
+    nan_run = algo(setup8, faults=target_plan(R, J, "nan", 2),
+                   return_state=True, **kw)
+    drop_run = algo(setup8, faults=target_plan(R, J, "drop", 2),
+                    return_state=True, **kw)
+    for key in ("train_loss", "test_loss", "test_acc"):
+        assert np.all(np.isfinite(nan_run[key])), key
+        np.testing.assert_array_equal(nan_run[key], drop_run[key],
+                                      err_msg=key)
+    np.testing.assert_array_equal(np.asarray(nan_run["params"]["w"]),
+                                  np.asarray(drop_run["params"]["w"]))
+    np.testing.assert_array_equal(np.asarray(nan_run["p"]),
+                                  np.asarray(drop_run["p"]))
+    # the quarantine caught the poisoned client every round...
+    np.testing.assert_array_equal(
+        nan_run["fault_counts"]["quarantined"], np.full(R, 1))
+    # ...and the faulty run actually differs from the clean one
+    clean = algo(setup8, **kw)
+    assert not np.allclose(clean["test_loss"], nan_run["test_loss"])
+
+
+def test_inf_poison_also_quarantined(setup8):
+    R, J = KW["round"], setup8.num_clients
+    res = FedAvg(setup8, faults=target_plan(R, J, "nan", 1, fill=np.inf),
+                 **KW)
+    assert np.all(np.isfinite(res["train_loss"]))
+    assert res["fault_counts"]["quarantined"].sum() == R
+
+
+@pytest.mark.parametrize("kind", ["drop", "nan"])
+def test_all_clients_faulty_round_leaves_model_unchanged(setup8, kind):
+    J = setup8.num_clients
+    zeros, ones = np.zeros((1, J), np.float32), np.ones((1, J), np.float32)
+    if kind == "drop":
+        plan = FaultPlan(ones, zeros, zeros, ones, zeros, zeros)
+    else:  # every client reports NaN -> every client quarantined
+        plan = FaultPlan(zeros, zeros, ones, ones, ones,
+                         np.full((1, J), np.nan, np.float32))
+    res = FedAvg(setup8, faults=plan, round=1, return_state=True,
+                 **{k: v for k, v in KW.items() if k != "round"})
+    init = core._derive_params(setup8.model.init, KW["seed"],
+                               setup8.D, setup8.num_classes)
+    np.testing.assert_array_equal(np.asarray(res["params"]["w"]),
+                                  np.asarray(init["w"]))
+    assert np.all(np.isfinite(res["test_loss"]))
+
+
+def test_straggler_shrinks_the_update(setup8):
+    """A straggler's report pulls the aggregate LESS than its full
+    update: the faulted round's params differ from clean, stay finite,
+    and land between a full drop and the clean run."""
+    R, J = KW["round"], setup8.num_clients
+    clean = FedAvg(setup8, return_state=True, **KW)
+    strag = FedAvg(setup8, faults=target_plan(R, J, "straggle", 0,
+                                              frac=0.25),
+                   return_state=True, **KW)
+    assert np.all(np.isfinite(strag["test_loss"]))
+    assert not np.array_equal(np.asarray(strag["params"]["w"]),
+                              np.asarray(clean["params"]["w"]))
+    assert strag["fault_counts"]["straggled"].sum() == R
+
+
+def test_fednova_accepts_faults(setup8):
+    res = FedNova(setup8, faults="drop=0.25,corrupt=0.25:nan,seed=5",
+                  **KW)
+    assert np.all(np.isfinite(res["test_loss"]))
+    counts = res["fault_counts"]
+    assert counts["quarantined"].sum() == counts["corrupted"].sum()
+
+
+def test_sign_flip_defended_by_median_and_clip(setup8):
+    """Finite corruption (sign flip) sails through the quarantine by
+    design; the opt-in robust aggregators are the defense."""
+    R, J = KW["round"], setup8.num_clients
+    plan = target_plan(R, J, "sign", 0)
+    for agg in ("median", "clip:1+trim:1"):
+        res = FedAvg(setup8, faults=plan, robust_agg=agg, **KW)
+        assert np.all(np.isfinite(res["test_loss"])), agg
+        assert res["fault_counts"]["corrupted"].sum() == R
+        assert res["fault_counts"]["quarantined"].sum() == 0
+
+
+def test_robust_agg_without_faults_runs(setup8):
+    res = FedAvg(setup8, robust_agg="trim:1", **KW)
+    assert np.all(np.isfinite(res["test_loss"]))
+    assert "fault_counts" not in res  # no plan, no fault report
+
+
+# -- FedAMW partial participation / masked p --------------------------
+
+def test_fedamw_accepts_partial_participation(setup8):
+    full = FedAMW(setup8, **AMW_KW)
+    dflt = FedAMW(setup8, participation=1.0, **AMW_KW)
+    np.testing.assert_array_equal(full["test_acc"], dflt["test_acc"])
+    half = FedAMW(setup8, participation=0.5, **AMW_KW)
+    assert np.all(np.isfinite(half["test_loss"]))
+    assert not np.allclose(full["train_loss"], half["train_loss"])
+
+
+def test_fedamw_dropout_zero_mass_and_masked_simplex(setup8,
+                                                     monkeypatch):
+    """A client dropped every round earns exactly zero mixture mass,
+    and under the simplex guard the learned p lives on the MASKED
+    simplex: zero on invalid clients, the rest summing to 1."""
+    R, J = AMW_KW["round"], setup8.num_clients
+    plan = target_plan(R, J, "drop", 3)
+    res = FedAMW(setup8, faults=plan, return_state=True, **AMW_KW)
+    assert float(np.asarray(res["p"])[3]) == 0.0  # unguarded too
+
+    monkeypatch.setenv("FEDAMW_P_GUARD", "simplex")
+    guarded = FedAMW(setup8, faults=plan, return_state=True, **AMW_KW)
+    p = np.asarray(guarded["p"])
+    assert p[3] == 0.0
+    assert np.all(p >= 0)
+    np.testing.assert_allclose(p.sum(), 1.0, atol=1e-5)
+    assert np.all(np.isfinite(guarded["test_loss"]))
+
+
+# -- zero-recompile + resume contracts --------------------------------
+
+def test_fault_plan_change_adds_no_recompile(setup8):
+    """The plan rows are DATA (scanned inputs), not program structure:
+    two runs under different plans share one trainer and one compiled
+    XLA program — the bench-grade zero-recompile contract, read from
+    the jit cache counter like tests/test_serve_contract.py."""
+    FedAvg(setup8, faults="drop=0.4,corrupt=0.1:nan,seed=0", **KW)
+    fn = core._LAST_TRAIN_FN
+    size0 = fn._cache_size() if hasattr(fn, "_cache_size") else None
+    FedAvg(setup8, faults="drop=0.1,straggle=0.3:0.5,seed=99", **KW)
+    assert core._LAST_TRAIN_FN is fn  # same memoized trainer
+    if size0 is not None:
+        assert fn._cache_size() == size0  # same compiled program
+
+
+def test_faults_resume_replays_identical_plan(setup8):
+    """Prefix + resume == the uninterrupted faulty run: plan rows are
+    generated for the FULL horizon and sliced, exactly like the LR
+    schedule and key streams."""
+    kw = dict(lr=0.5, epoch=1, batch_size=32, seed=0,
+              lr_mode="reference", faults="drop=0.3,corrupt=0.2:nan,seed=3")
+    full = FedAvg(setup8, round=4, return_state=True, **kw)
+    prefix = FedAvg(setup8, round=4, stop_round=2, return_state=True,
+                    **kw)
+    resumed = FedAvg(setup8, round=4, start_round=2,
+                     resume_from={"params": prefix["params"]},
+                     return_state=True, **kw)
+    np.testing.assert_array_equal(resumed["test_acc"],
+                                  np.asarray(full["test_acc"])[2:])
+    np.testing.assert_array_equal(np.asarray(resumed["params"]["w"]),
+                                  np.asarray(full["params"]["w"]))
+    np.testing.assert_array_equal(
+        resumed["fault_counts"]["quarantined"],
+        full["fault_counts"]["quarantined"][2:])
+
+
+# -- surface checks ---------------------------------------------------
+
+def test_oneshot_algorithms_reject_faults(setup8):
+    from fedamw_tpu.algorithms import Centralized, Distributed
+    for fn in (Centralized, Distributed, FedAMW_OneShot):
+        with pytest.raises(ValueError, match="faults"):
+            fn(setup8, epoch=1, faults="drop=0.1")
+        with pytest.raises(ValueError, match="faults"):
+            fn(setup8, epoch=1, robust_agg="median")
+
+
+def test_fault_counts_and_report(setup8):
+    res = FedAvg(setup8, faults="drop=0.5,seed=2", **KW)
+    counts = res["fault_counts"]
+    valid = (np.asarray(setup8.sizes) > 0)
+    plan = FaultPlan.build(FaultSpec(drop=0.5, seed=2), KW["round"],
+                           setup8.num_clients)
+    np.testing.assert_array_equal(
+        counts["dropped"], (plan.drop * valid).sum(1).astype(int))
+
+    from fedamw_tpu.utils.reporting import (fault_summary,
+                                            format_fault_report)
+    s = fault_summary(counts)
+    assert s["total_dropped"] == counts["dropped"].sum()
+    assert s["rounds"] == KW["round"]
+    line = format_fault_report("FedAvg", counts)
+    assert "FedAvg" in line and f"{s['total_dropped']} dropped" in line

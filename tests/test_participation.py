@@ -68,9 +68,16 @@ def test_fednova_partial_participation(setup8):
     assert half["test_acc"][-1] > 30.0
 
 
-def test_fedamw_rejects_partial_participation(setup8):
-    with pytest.raises(ValueError, match="full participation"):
-        FedAMW(setup8, participation=0.5, round=2)
+def test_fedamw_accepts_partial_participation(setup8):
+    """FedAMW used to hard-reject participation<1; the fault-plane PR
+    lifted that — the p-solver runs masked over the present clients
+    (tests/test_faults.py pins the masked-p semantics in depth)."""
+    kw = dict(lr=0.5, epoch=1, round=3, seed=0, lr_mode="constant",
+              lambda_reg=1e-4, lr_p=1e-3)
+    full = FedAMW(setup8, **kw)
+    half = FedAMW(setup8, participation=0.5, **kw)
+    assert np.all(np.isfinite(half["test_loss"]))
+    assert not np.allclose(full["train_loss"], half["train_loss"])
 
 
 def test_torch_fedamw_rejects_partial_participation():

@@ -540,6 +540,95 @@ def test_overload_bound_is_atomic_under_concurrent_submits():
     assert svc.metrics.requests_served == 8
 
 
+# -- transient-dispatch retry (ISSUE 2 satellite) ---------------------
+
+def test_transient_engine_failure_retried_with_backoff():
+    """A flapping engine backend (here: two UNAVAILABLE failures, then
+    success) is absorbed by the bounded retry — every future resolves
+    with its result, and the retry counter lands in the metrics
+    snapshot."""
+    engine = _engine()
+    real_predict = engine.predict
+    state = {"fails": 2}
+
+    def flaky(X):
+        if state["fails"] > 0:
+            state["fails"] -= 1
+            raise RuntimeError("UNAVAILABLE: backend tunnel hiccup")
+        return real_predict(X)
+
+    engine.predict = flaky
+    with ServingService(engine, max_wait_ms=20.0, retries=2,
+                        retry_backoff_ms=1.0) as svc:
+        f1 = svc.submit(np.zeros((2, 16), np.float32))
+        f2 = svc.submit(np.ones((3, 16), np.float32))
+        np.testing.assert_array_equal(
+            f1.result(timeout=30),
+            real_predict(np.zeros((2, 16), np.float32)))
+        f2.result(timeout=30)
+        snap = svc.metrics.snapshot()
+    assert snap["retries"] == 2
+    assert snap["requests"] == 2
+
+
+def test_transient_failure_beyond_budget_fails_every_future():
+    engine = _engine()
+
+    def always_down(X):
+        raise ConnectionError("engine unreachable")
+
+    engine.predict = always_down
+    with ServingService(engine, max_wait_ms=20.0, retries=1,
+                        retry_backoff_ms=1.0) as svc:
+        f = svc.submit(np.zeros((2, 16), np.float32))
+        with pytest.raises(ConnectionError):
+            f.result(timeout=30)
+        assert svc.metrics.retries == 1  # budget spent, then fail fast
+
+
+def test_permanent_engine_error_fails_fast_without_retry():
+    """ValueError/TypeError (and anything not matching the transient
+    markers) must not burn retry latency — same-batch redispatch can
+    only fail identically."""
+    engine = _engine()
+
+    def broken(X):
+        raise ValueError("shape mismatch inside the engine")
+
+    engine.predict = broken
+    with ServingService(engine, max_wait_ms=20.0, retries=3,
+                        retry_backoff_ms=50.0) as svc:
+        f = svc.submit(np.zeros((2, 16), np.float32))
+        with pytest.raises(ValueError):
+            f.result(timeout=30)
+        assert svc.metrics.retries == 0
+
+
+def test_retry_respects_request_deadline():
+    """An always-transient engine + a short request deadline: the
+    request resolves DeadlineExceeded (shed as 'deadline') rather than
+    burning the full backoff schedule past its deadline — the retry
+    loop caps each sleep at the earliest live deadline and sheds
+    expired requests between attempts."""
+    engine = _engine()
+
+    def always_down(X):
+        raise OSError("connection reset")
+
+    engine.predict = always_down
+    with ServingService(engine, max_wait_ms=1.0, retries=50,
+                        retry_backoff_ms=40.0) as svc:
+        t0 = time.perf_counter()
+        f = svc.submit(np.zeros((2, 16), np.float32), timeout_s=0.15)
+        with pytest.raises(DeadlineExceeded):
+            f.result(timeout=30)
+        # 50 x 40ms+ of blind backoff would be 2s+; the deadline cap
+        # ends the episode within a few sleep quanta of the deadline
+        assert time.perf_counter() - t0 < 1.5
+    assert svc.metrics.shed_deadline == 1
+    assert svc.metrics.retries >= 1
+
+
 # -- registry surface -------------------------------------------------
 
 def test_registry_exposes_serving():
