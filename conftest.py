@@ -10,6 +10,14 @@ fake-mesh trick for exercising multi-chip sharding without hardware.
 
 import os
 
+# RobustSpec canonical round-trip guard (fedcore.robust): under the
+# test suite, EVERY accepted robust_agg spelling — wherever a test or
+# fixture parses one — must satisfy parse(canonical(parse(s))) ==
+# parse(s), or a new token could silently split the trainer jit cache
+# (canonical() is a cache-key component). Enabled here rather than in
+# each test so the whole suite sweeps the contract for free.
+os.environ.setdefault("FEDAMW_SPEC_ROUNDTRIP_CHECK", "1")
+
 if os.environ.get("FEDAMW_TEST_PLATFORM", "cpu") == "cpu":
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")

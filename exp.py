@@ -94,13 +94,27 @@ def parse_args():
                          "quarantine counts are reported after each "
                          "algorithm")
     ap.add_argument("--robust_agg", type=str, default="mean",
-                    metavar="mean|median|trim:K|clip:R[+...]",
+                    metavar="mean|median|trim:K|krum|mkrum:M|geomed[:T]"
+                            "|clip:R|quarantine:Z[+...]",
                     help="extension (jax): robust aggregation for the "
                          "round-based algorithms (fedcore.robust) — "
                          "non-finite reports are always quarantined "
-                         "under faults; this adds norm clipping and/or "
-                         "coordinate-wise trimmed-mean/median in place "
-                         "of the weighted average")
+                         "under faults; this adds norm clipping, "
+                         "z-score quarantine of finite outliers "
+                         "(quarantine:Z), and/or a Byzantine-robust "
+                         "reduction (coordinate-wise trimmed-mean/"
+                         "median, krum/multi-Krum, geometric median) "
+                         "in place of the weighted average; defense "
+                         "telemetry is reported after each algorithm")
+    ap.add_argument("--feature_dtype", type=str, default=None,
+                    choices=["bfloat16", "float16", "float32"],
+                    help="extension (jax): store the mapped feature "
+                         "matrices in a narrower dtype (halves the "
+                         "dominant HBM resident; compute stays "
+                         "float32 — prepare_setup(feature_dtype=...), "
+                         "tests/test_bf16.py). The marker is persisted "
+                         "into --save_models checkpoints so serving "
+                         "narrows raw inputs the same way")
     ap.add_argument("--server_opt", type=str, default="none",
                     choices=["none", "sgd", "adam", "yogi", "adagrad"],
                     help="extension: FedOpt server optimizer on the "
@@ -184,6 +198,9 @@ def parse_args():
             parse_robust_spec(args.robust_agg)
         except ValueError as e:
             ap.error(str(e))
+    if args.feature_dtype is not None and args.backend != "jax":
+        ap.error("--feature_dtype is a jax-backend extension (the "
+                 "torch twin keeps the reference's float32 features)")
     if args.multihost:
         if args.backend != "jax":
             ap.error("--multihost requires --backend jax")
@@ -360,9 +377,13 @@ _RESUME_LEGACY_DEFAULTS = {"model": "linear", "data_dir": "datasets",
                            # are unguarded), so a keyless partial IS
                            # an unguarded run
                            "p_guard": None,
-                           # fault plane (this PR): a partial without
+                           # fault plane (PR 2): a partial without
                            # these keys is by construction a clean run
                            "faults": None, "robust_agg": "mean",
+                           # narrow features (this PR): a keyless
+                           # partial predates --feature_dtype and is a
+                           # float32-feature run
+                           "feature_dtype": None,
                            # FedAMW used to reject participation<1, so
                            # a legacy partial's FedAMW rows are always
                            # full-participation runs; signing the value
@@ -395,6 +416,7 @@ def _resume_config(args) -> dict:
                       else None)
     cfg["faults"] = args.faults
     cfg["robust_agg"] = args.robust_agg
+    cfg["feature_dtype"] = args.feature_dtype
     # see _RESUME_LEGACY_DEFAULTS: jax FedAMW now honors participation
     cfg["amw_participation"] = (args.participation
                                 if args.backend == "jax" else 1.0)
@@ -491,6 +513,14 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete,
     lam = params["lambda_reg"]
     lam_os = params.get("lambda_reg_os", lam)
     R = args.round
+    feat_dtype = None
+    if args.feature_dtype:
+        # argparse-guarded to the jax backend; resolved to the jnp
+        # scalar type prepare_setup narrows with (tests/test_bf16.py)
+        import jax.numpy as jnp
+
+        feat_dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
+                      "float32": jnp.float32}[args.feature_dtype]
 
     for t in range(start_repeat, args.n_repeats):
         rng = np.random.RandomState(args.seed + t)
@@ -507,6 +537,8 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete,
             # explicit default == default; the torch backend (linear
             # only, argparse-guarded) swallows unknown kwargs
             model=args.model,
+            **({"feature_dtype": feat_dtype} if feat_dtype is not None
+               else {}),
         )
         if args.shard:
             from fedamw_tpu.parallel import make_mesh, shard_setup
@@ -595,6 +627,11 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete,
                 from fedamw_tpu.utils.reporting import format_fault_report
 
                 print(format_fault_report(name, res["fault_counts"]))
+            if "defense" in res:
+                from fedamw_tpu.utils.reporting import \
+                    format_defense_report
+
+                print(format_defense_report(name, res["defense"]))
             if "params" in res and _is_writer(args):
                 # one writer (matches the result-pickle gate): global
                 # params/p are replicated, so process 0 has the full
@@ -611,8 +648,11 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete,
                                  f"{args.dataset}_{name}_repeat{t}"),
                     res["params"], p=res["p"], round_idx=R, extra=extra,
                     # the RFF draw makes the checkpoint self-contained
-                    # for serving RAW inputs (serving.ServingEngine)
+                    # for serving RAW inputs (serving.ServingEngine);
+                    # the feature-dtype marker keeps serving's raw-input
+                    # narrowing matched to how the head was trained
                     rff=getattr(setup, "rff", None),
+                    feature_dtype=feat_dtype,
                 )
                 print(f"{name}: checkpoint -> {where}")
         print(f"[repeat {t}] wall time {time.time() - t0:.1f}s "

@@ -25,6 +25,7 @@ documented and switchable where meaningful):
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 import warnings
 
@@ -45,9 +46,11 @@ from ..fedcore import (
 from ..fedcore.faults import inject_fault_row, resolve_fault_plan
 from ..fedcore.robust import (
     clip_update_norms,
+    krum_select,
     make_robust_aggregator,
     parse_robust_spec,
     sanitize_updates,
+    zscore_quarantine,
 )
 from ..ops.schedule import lr_schedule_array
 from .common import FedSetup, result_tuple
@@ -167,21 +170,34 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
     # different plan reuses the same compiled program (zero recompiles).
     rspec = parse_robust_spec(robust_agg)
     robust_on = not rspec.is_default
-    aggregate_robust = make_robust_aggregator(rspec)
+    # Krum-family selection on the LEARNED path folds into the present
+    # mask BEFORE the p-solve — deselected clients carry exactly zero
+    # learned mixture mass (like dropped/quarantined ones) and the
+    # aggregate stays the learned weighted average over the selected
+    # set; the fixed path keeps the classic unweighted mean-of-selected
+    # (Blanchard et al.). agg_spec is what the aggregation stage
+    # actually runs.
+    sel_m = rspec.select_m if aggregation == "learned" else None
+    agg_spec = (dataclasses.replace(rspec, agg="mean", mkrum_m=0)
+                if sel_m is not None else rspec)
+    aggregate_robust = make_robust_aggregator(agg_spec)
 
     def guard_faults(params, stacked, losses, present, part_key_t,
                      fault_row):
         """Shared fault/participation/sanitize prologue of a 'fancy'
         round: starting from the valid-client mask in ``present``,
         returns the cleaned reports, the final present-client mask,
-        and the round's quarantine count."""
+        the round's non-finite quarantine count, and the scored-
+        quarantine telemetry (``quarantine:Z`` — the z-test runs on
+        UNCLIPPED delta norms over the post-sanitize present set and
+        folds into the same mask)."""
         if participation < 1.0:
             present = present * (
                 jax.random.uniform(part_key_t, present.shape)
                 < participation
             ).astype(jnp.float32)
         if faults_on:
-            f_drop, f_scale, f_poison, f_fill = fault_row
+            f_drop, f_scale, f_poison, f_fill, _f_tau = fault_row
             stacked, losses = inject_fault_row(
                 params, stacked, losses, f_scale, f_poison, f_fill)
             present = present * (1.0 - f_drop)
@@ -189,22 +205,38 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
         stacked, losses, ok = sanitize_updates(params, stacked, losses)
         present = present * ok
         quar_t = jnp.sum(reported * (1.0 - ok))
-        return stacked, losses, present, quar_t
+        aux = {}
+        if rspec.zscore is not None:
+            # under an active plan, score full-work-EQUIVALENT norms:
+            # the tau_frac row divides out each straggler's reported
+            # work fraction, so a majority-straggle round cannot shift
+            # the median down and quarantine the honest full-work
+            # clients (see zscore_quarantine's docstring)
+            zok, z = zscore_quarantine(
+                params, stacked, present, rspec.zscore,
+                work_frac=fault_row[4] if faults_on else None)
+            aux["z_quarantined"] = jnp.sum(present * (1.0 - zok))
+            aux["z_max"] = jnp.max(z)
+            present = present * zok
+        return stacked, losses, present, quar_t, aux
 
     def robust_round_aggregate(params, stacked, w_t, present):
         """Clip + robust reduction + the all-absent no-op gate. The
         gate checks weight MASS for the mean aggregator (a learned p
         could put zero or negative total mass on the present set) and
-        headcount for the order-statistic ones (which ignore weights)."""
+        headcount for the order-statistic ones (which ignore weights).
+        Returns ``(params, aux)`` — aux is the aggregator's defense
+        telemetry (krum selection / geomed residual)."""
         if rspec.clip is not None:
             stacked = clip_update_norms(params, stacked, rspec.clip)
-        agg = aggregate_robust(stacked, w_t, present)
-        if rspec.agg == "mean":
+        agg, aux = aggregate_robust(params, stacked, w_t, present)
+        if agg_spec.agg == "mean":
             ok_round = jnp.sum(jnp.abs(w_t)) > 0
         else:
             ok_round = jnp.sum(present) > 0
         return jax.tree.map(
-            lambda new, old: jnp.where(ok_round, new, old), agg, params)
+            lambda new, old: jnp.where(ok_round, new, old), agg,
+            params), aux
 
     if aggregation == "learned":
         solve, init_opt = make_p_solver(task, n_val, val_batch_size, lr_p,
@@ -253,8 +285,9 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 params, p, opt_state = carry
                 if faults_on:
                     (t, lr_t, keys_t, pkey_t, part_key_t,
-                     f_drop, f_scale, f_poison, f_fill) = inp
-                    fault_row = (f_drop, f_scale, f_poison, f_fill)
+                     f_drop, f_scale, f_poison, f_fill, f_tau) = inp
+                    fault_row = (f_drop, f_scale, f_poison, f_fill,
+                                 f_tau)
                 elif use_part:
                     t, lr_t, keys_t, pkey_t, part_key_t = inp
                     fault_row = None
@@ -265,9 +298,20 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                     params, X, y, idx, mask, keys_t, lr_t, mu, lam,
                 )
                 if fancy:
-                    stacked, losses, present, quar_t = guard_faults(
-                        params, stacked, losses, client_valid,
-                        part_key_t, fault_row)
+                    stacked, losses, present, quar_t, dfaux = \
+                        guard_faults(params, stacked, losses,
+                                     client_valid, part_key_t,
+                                     fault_row)
+                    if sel_m is not None:
+                        # krum/mkrum on the learned path: selection is
+                        # a present-mask fold, so deselected clients
+                        # are quarantined for this round's mixture —
+                        # the defense contract FedAMW's zero-mass
+                        # telemetry pins
+                        selected = krum_select(params, stacked,
+                                               present, sel_m)
+                        present = present * selected
+                        dfaux["krum_selected"] = selected
                     # Absent/quarantined clients carry EXACTLY zero
                     # mixture mass: p and its momentum are masked
                     # before the solve (a client whose report never
@@ -295,10 +339,12 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                         lambda new, old: jnp.where(any_p, new, old),
                         opt_s, opt_state)
                     w_t = participation_weights(p_s, present)
-                    params = robust_round_aggregate(
+                    params, agg_aux = robust_round_aggregate(
                         params, stacked, w_t, present)
+                    dfaux.update(agg_aux)
                 else:
                     quar_t = jnp.float32(0.0)
+                    dfaux = {}
                     train_loss_t = jnp.sum(p * losses)  # current p (tools.py:434)
                     logits = client_logits(apply_fn, stacked, X_val)
                     p, opt_state, _, _ = solve(
@@ -308,15 +354,17 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                     params = weighted_average(stacked, p)
                 tl, ta = evaluate(params, X_test, y_test)
                 stream_metrics(t, train_loss_t, tl, ta)
-                ys = (train_loss_t, tl, ta)
+                ys = {"train_loss": train_loss_t, "test_loss": tl,
+                      "test_acc": ta}
                 if faults_on:
-                    ys = ys + (quar_t,)
+                    ys["quarantined"] = quar_t
+                ys.update(dfaux)
                 return (params, p, opt_state), ys
 
             (params, p, opt_state), metrics = jax.lax.scan(
                 body, (params, p, opt_state), tuple(xs),
             )
-            return jnp.stack(metrics), params, p, opt_state
+            return metrics, params, p, opt_state
 
         return train
 
@@ -376,8 +424,8 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
             params, opt_state = carry
             if faults_on:
                 (t, lr_t, keys_t, part_key_t,
-                 f_drop, f_scale, f_poison, f_fill) = inp
-                fault_row = (f_drop, f_scale, f_poison, f_fill)
+                 f_drop, f_scale, f_poison, f_fill, f_tau) = inp
+                fault_row = (f_drop, f_scale, f_poison, f_fill, f_tau)
             else:
                 t, lr_t, keys_t, part_key_t = inp
                 fault_row = None
@@ -385,19 +433,32 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 params, X, y, idx, mask, keys_t, lr_t, mu, lam,
             )
             quar_t = jnp.float32(0.0)
+            dfaux = {}
             if faults_on or robust_on:
                 # the fault/robust round: participation, drop, and
                 # quarantine masks fold into one present-client set;
                 # both weight families renormalize over it and the
                 # (possibly order-statistic) aggregate is gated back to
                 # the old params when the round has nobody left
-                stacked, losses, present, quar_t = guard_faults(
+                stacked, losses, present, quar_t, dfaux = guard_faults(
                     params, stacked, losses, valid, part_key_t,
                     fault_row)
-                w_t = participation_weights(agg_w, present)
+                if aggregation == "nova" and faults_on:
+                    # straggler-exact tau: the plan's per-round work
+                    # fraction rescales each client's local step count,
+                    # so normalized averaging reflects the work
+                    # ACTUALLY done, not the full-epoch assumption
+                    # (an all-ones row reproduces agg_w bitwise)
+                    agg_w_t = fednova_effective_weights(
+                        sizes, p_fixed, epoch, batch_size,
+                        tau_frac=fault_row[4])
+                else:
+                    agg_w_t = agg_w
+                w_t = participation_weights(agg_w_t, present)
                 loss_w = participation_weights(p_fixed, present)
-                agg = robust_round_aggregate(params, stacked, w_t,
-                                             present)
+                agg, agg_aux = robust_round_aggregate(
+                    params, stacked, w_t, present)
+                dfaux.update(agg_aux)
                 train_loss_t = jnp.sum(loss_w * losses)
             elif participation < 1.0:
                 part = valid * (
@@ -430,9 +491,11 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 params = optax.apply_updates(params, updates)
             tl, ta = evaluate(params, X_test, y_test)
             stream_metrics(t, train_loss_t, tl, ta)
-            ys = (train_loss_t, tl, ta)
+            ys = {"train_loss": train_loss_t, "test_loss": tl,
+                  "test_acc": ta}
             if faults_on:
-                ys = ys + (quar_t,)
+                ys["quarantined"] = quar_t
+            ys.update(dfaux)
             return (params, opt_state), ys
 
         opt_state0 = (() if server_tx is None
@@ -446,7 +509,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
         (params, opt_state), metrics = jax.lax.scan(
             body, (params, opt_state0), tuple(xs)
         )
-        return jnp.stack(metrics), params, p_fixed, opt_state
+        return metrics, params, p_fixed, opt_state
 
     return train
 
@@ -717,19 +780,27 @@ def _round_based(
 
     ``faults`` (None | spec string | FaultSpec | FaultPlan) injects
     deterministic client faults per round (``fedcore.faults``);
-    ``robust_agg`` ("mean" | "median" | "trim:K" | "clip:R" | "+"
-    combinations, ``fedcore.robust``) selects the defense. Both are
-    static trainer configuration; the plan's per-round rows are dynamic
-    scanned inputs, so changing the plan never recompiles. With faults
-    active the result carries ``fault_counts`` (per-round dropped /
-    straggled / corrupted / quarantined).
+    ``robust_agg`` ("mean" | "median" | "trim:K" | "krum" | "mkrum:M"
+    | "geomed[:T]" | "clip:R" | "quarantine:Z" | "+" combinations,
+    ``fedcore.robust``) selects the defense. Both are static trainer
+    configuration; the plan's per-round rows are dynamic scanned
+    inputs, so changing the plan never recompiles. With faults active
+    the result carries ``fault_counts`` (per-round dropped / straggled
+    / corrupted / quarantined); an active defense additionally carries
+    ``defense`` (scored-quarantine counts and max z, krum selection
+    masks and pick counts, geomed Weiszfeld residuals). Under faults
+    FedNova's tau normalization is straggler-exact: the plan's
+    per-round work fraction rescales each tau
+    (``fednova_effective_weights(tau_frac=...)``).
 
     Every array is an explicit jit argument — a closure-captured device
     array would be baked into the HLO as a literal constant (hundreds of
     MB for the feature matrix), bloating compile payloads. The jitted
     trainer itself is memoized on the static config, and one algorithm
-    call is ONE dispatch + ONE (3, rounds) metric fetch (remote-TPU
-    round-trips dominate otherwise; see _cached_round_trainer).
+    call is ONE dispatch + ONE fetch of the per-round metric streams (a
+    dict of (rounds,)-shaped arrays — train/test losses and accuracy,
+    plus quarantine and defense telemetry when active; remote-TPU
+    round-trips dominate otherwise, see _cached_round_trainer).
     """
     if not 0.0 < participation <= 1.0:
         raise ValueError(f"participation must be in (0, 1], got "
@@ -875,21 +946,45 @@ def _round_based(
 
     metrics, fparams, fp, fopt = train(*args)
 
-    metrics = np.asarray(metrics)
-    out = result_tuple(metrics[0], metrics[1], metrics[2])
+    metrics = {k: np.asarray(v) for k, v in metrics.items()}
+    out = result_tuple(metrics["train_loss"], metrics["test_loss"],
+                       metrics["test_acc"])
     if faults_on:
         # per-round observability (utils.reporting.format_fault_report):
         # the role counts are plan facts over the real clients
         # (host-side), quarantined is the runtime verdict from the
-        # non-finite sanitizer (the 4th scanned metric row)
+        # non-finite sanitizer (a scanned metric stream)
         valid_np = (np.asarray(setup.sizes) > 0).astype(np.float64)
         sl = slice(start_round, stop)
         out["fault_counts"] = {
             "dropped": (plan.drop[sl] * valid_np).sum(1).astype(int),
             "straggled": (plan.straggle[sl] * valid_np).sum(1).astype(int),
             "corrupted": (plan.corrupt[sl] * valid_np).sum(1).astype(int),
-            "quarantined": np.rint(metrics[3]).astype(int),
+            "quarantined": np.rint(metrics["quarantined"]).astype(int),
         }
+    # defense telemetry (utils.reporting.format_defense_report): the
+    # scored-quarantine verdicts, krum selection masks, and Weiszfeld
+    # residuals the active robust_agg spec emitted per round
+    defense = {}
+    if "z_quarantined" in metrics:
+        defense["z_quarantined"] = np.rint(
+            metrics["z_quarantined"]).astype(int)
+        defense["z_max"] = metrics["z_max"]
+    if "krum_selected" in metrics:
+        sel = np.rint(metrics["krum_selected"]).astype(int)
+        defense["krum_selected"] = sel
+        defense["krum_pick_counts"] = sel.sum(axis=0)
+    if "geomed_residual" in metrics:
+        defense["geomed_residual"] = metrics["geomed_residual"]
+    if defense:
+        defense["robust_agg"] = robust_canonical
+        # inert padded clients (mesh-even packing) are never present,
+        # so per-client stats must not report them as "never selected"
+        # — defense_summary masks with this (same rationale as
+        # fault_counts' valid_np above)
+        defense["client_valid"] = (
+            np.asarray(setup.sizes) > 0).astype(int)
+        out["defense"] = defense
     if return_state:
         # final global model + mixture weights + optimizer state, for
         # checkpointing (utils/checkpoint.py); optimizer state travels

@@ -13,9 +13,13 @@ from .robust import (
     clip_update_norms,
     coordinatewise_median,
     coordinatewise_trimmed_mean,
+    geometric_median,
+    krum_aggregate,
+    krum_select,
     make_robust_aggregator,
     parse_robust_spec,
     sanitize_updates,
+    zscore_quarantine,
 )
 
 __all__ = [
@@ -27,7 +31,10 @@ __all__ = [
     "coordinatewise_median",
     "coordinatewise_trimmed_mean",
     "fednova_effective_weights",
+    "geometric_median",
     "inject_fault_row",
+    "krum_aggregate",
+    "krum_select",
     "make_bucketed_round",
     "make_client_round",
     "make_local_update",
@@ -39,4 +46,5 @@ __all__ = [
     "resolve_fault_plan",
     "sanitize_updates",
     "weighted_average",
+    "zscore_quarantine",
 ]

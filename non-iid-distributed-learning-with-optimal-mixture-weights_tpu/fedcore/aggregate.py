@@ -43,15 +43,27 @@ def weighted_average(stacked_params, p: jax.Array):
 
 
 def fednova_effective_weights(
-    sizes: jax.Array, p: jax.Array, epochs: int, batch_size: int
+    sizes: jax.Array, p: jax.Array, epochs: int, batch_size: int,
+    tau_frac: jax.Array | None = None,
 ) -> jax.Array:
     """FedNova normalized-averaging weights (reference ``tools.py:388-405``).
 
     ``tau_j = n_j * epochs / batch_size`` (float, the reference's exact
     expression — not the true step count ``ceil(n_j/B) * epochs``),
     ``tau_eff = sum_j tau_j p_j``; effective weight ``p_j tau_eff / tau_j``.
+
+    ``tau_frac`` (a per-client ``(J,)`` fraction in ``(0, 1]``, the
+    fault plan's per-round straggle row — ``FaultPlan.rows``) rescales
+    each tau by the local work the client ACTUALLY completed, making
+    the normalization straggler-exact: a client cut off at 50% of its
+    epochs contributes ``tau_j / 2`` to ``tau_eff`` and gets the
+    correspondingly LARGER per-step weight the FedNova rule assigns to
+    fewer local steps. ``None`` (and an all-ones row — multiplying by
+    1.0 is exact in float) reproduces the full-work weights bitwise.
     """
     tau = sizes.astype(jnp.float32) * epochs / batch_size
+    if tau_frac is not None:
+        tau = tau * tau_frac
     tau_eff = jnp.sum(tau * p)
     # Padded (empty) clients have tau=0 AND p=0; they must stay inert
     # rather than poison the aggregate with 0/0.

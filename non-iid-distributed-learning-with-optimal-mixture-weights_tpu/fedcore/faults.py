@@ -194,15 +194,24 @@ class FaultPlan:
         return cls(drop, straggle, corrupt, scale, poison, fill)
 
     def rows(self, start: int, stop: int):
-        """The in-graph slice: ``(drop, scale, poison, fill)`` device
-        arrays for rounds ``[start, stop)``, shaped to ride the round
-        scan as ordinary per-round inputs (the role masks
-        ``straggle``/``corrupt`` are reporting-only and stay host-side).
-        Sliced from the full horizon exactly like the LR schedule, so
-        prefix + resume replays the identical faults."""
+        """The in-graph slice: ``(drop, scale, poison, fill,
+        tau_frac)`` device arrays for rounds ``[start, stop)``, shaped
+        to ride the round scan as ordinary per-round inputs (the role
+        masks ``straggle``/``corrupt`` stay host-side for reporting).
+        ``tau_frac`` is the fraction of the local work each client
+        actually completed — ``straggle_frac`` on straggling cells, 1
+        elsewhere (a corrupt cell's scale is an adversarial multiplier,
+        not work done) — which is what makes FedNova's tau
+        normalization straggler-exact
+        (``aggregate.fednova_effective_weights``). Sliced from the full
+        horizon exactly like the LR schedule, so prefix + resume
+        replays the identical faults."""
         sl = slice(start, stop)
+        tau_frac = np.where(self.straggle > 0, self.scale,
+                            np.float32(1.0)).astype(np.float32)
         return tuple(jnp.asarray(a[sl]) for a in
-                     (self.drop, self.scale, self.poison, self.fill))
+                     (self.drop, self.scale, self.poison, self.fill,
+                      tau_frac))
 
 
 def resolve_fault_plan(faults, rounds: int, num_clients: int):

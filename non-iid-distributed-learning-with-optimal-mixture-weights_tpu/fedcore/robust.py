@@ -13,13 +13,36 @@ nothing here assumes the faults were *injected*):
   finite-but-huge update (the ``scale`` corruption mode, or a
   diverging client) is rescaled to at most ``max_norm`` in global L2
   over all leaves, bounding any one client's pull on the aggregate.
+- :func:`zscore_quarantine` — **scored quarantine** (the
+  ``quarantine:Z`` spec token): a finite client whose delta L2 norm
+  z-scores beyond ``Z`` against the round's present-client norm
+  distribution (robust median/MAD z — see the function docstring for
+  why not mean/std) is folded out of the same 0/1 present mask the
+  non-finite quarantine feeds, so survivor renormalization and
+  FedAMW's masked simplex solve work unchanged. One pass, no
+  re-test over the reduced set.
 - :func:`coordinatewise_trimmed_mean` / :func:`coordinatewise_median`
   — the standard Byzantine-robust aggregators (Yin et al., 2018,
   arXiv:1803.01498): per coordinate, drop the ``k`` largest and
   smallest reports (or take the median) over the *present* clients.
-  Deliberately **unweighted** over that set, per the paper — mixture
-  weights don't apply to order statistics; callers opt in via the
-  ``robust_agg`` spec and keep ``weighted_average`` as the default.
+- :func:`krum_select` / :func:`krum_aggregate` — Krum and multi-Krum
+  (Blanchard et al., 2017, NeurIPS): score each present client by the
+  summed squared distances to its closest present neighbors, keep the
+  ``m`` best-scored (``m=1`` is classic Krum), average them
+  unweighted. Selection is a fixed top-k via ``where``-gated sort, so
+  it is shape-stable under any per-round present set.
+- :func:`geometric_median` — smoothed Weiszfeld (RFA, Pillutla et
+  al., 2022, IEEE TSP) with a STATIC iteration count, unweighted over
+  the present clients like the other order statistics.
+
+The order-statistic/distance aggregators are deliberately
+**unweighted** over the present set — mixture weights don't apply to
+order statistics; callers opt in via the ``robust_agg`` spec and keep
+``weighted_average`` as the default. (FedAMW instead folds the
+krum/mkrum *selection* into its present mask before the p-solve, so
+deselected clients carry exactly zero learned mass and the aggregate
+stays the learned weighted average over the selected set —
+``algorithms.core``.)
 
 Everything is shape-stable and jit-safe: masks arrive as traced 0/1
 vectors, order statistics use a full sort with invalid entries pushed
@@ -29,102 +52,187 @@ so the round trainer compiles once.
 
 ``robust_agg`` spec syntax (the ``exp.py --robust_agg`` surface):
 ``"mean"`` (default, today's exact graph), ``"median"``, ``"trim:K"``,
-``"clip:R"`` (clip + mean), or ``+``-joined combinations like
-``"clip:5+trim:1"`` (clip first, then the robust reduction).
+``"krum"``, ``"mkrum:M"``, ``"geomed[:T]"`` (T Weiszfeld iterations,
+default 8), ``"clip:R"`` (clip + mean), ``"quarantine:Z"`` (z-score
+quarantine + mean), or ``+``-joined combinations like
+``"clip:5+trim:1"`` or ``"quarantine:3+mkrum:6"`` (detection first,
+then clip, then the robust reduction).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 
 from .aggregate import weighted_average
 
+# geomed's default smoothed-Weiszfeld iteration count (static — it
+# sets the unrolled loop length inside the jitted round scan)
+GEOMED_ITERS_DEFAULT = 8
+
+# set (by conftest) to make every parse_robust_spec call verify the
+# canonical round-trip contract: parse(canonical(parse(s))) == parse(s)
+# for the accepted spelling s — a new token whose canonical spelling
+# drifts from its parse would otherwise silently split the trainer jit
+# cache (canonical() is a cache-key component)
+SPEC_ROUNDTRIP_ENV = "FEDAMW_SPEC_ROUNDTRIP_CHECK"
+
 
 @dataclasses.dataclass(frozen=True)
 class RobustSpec:
-    """Parsed ``robust_agg`` spec: aggregator choice + optional clip."""
+    """Parsed ``robust_agg`` spec: aggregator choice + optional
+    norm clip + optional z-score quarantine threshold."""
 
-    agg: str = "mean"           # mean | median | trim
+    agg: str = "mean"           # mean | median | trim | krum | mkrum | geomed
     trim: int = 0               # k, for agg == "trim"
+    mkrum_m: int = 0            # M, for agg == "mkrum" (krum is M=1)
+    geomed_iters: int = 0       # Weiszfeld iterations, for agg == "geomed"
     clip: float | None = None   # max delta L2 norm, or None
+    zscore: float | None = None  # quarantine z threshold, or None
 
     def canonical(self) -> str:
         """One spelling per spec — used as a trainer cache-key
-        component, so equivalent spellings share a compiled program."""
+        component, so equivalent spellings share a compiled program.
+        Contract (test-pinned): parsing the canonical spelling yields
+        this spec back, and canonical() is a fixed point."""
         parts = []
         if self.clip is not None:
             parts.append(f"clip:{self.clip}")
+        if self.zscore is not None:
+            parts.append(f"quarantine:{self.zscore}")
         if self.agg == "trim":
             parts.append(f"trim:{self.trim}")
-        elif self.agg == "median":
-            parts.append("median")
+        elif self.agg == "mkrum":
+            parts.append(f"mkrum:{self.mkrum_m}")
+        elif self.agg == "geomed":
+            parts.append(f"geomed:{self.geomed_iters}")
+        elif self.agg != "mean":
+            parts.append(self.agg)
         return "+".join(parts) or "mean"
 
     @property
     def is_default(self) -> bool:
-        return self.agg == "mean" and self.clip is None
+        return (self.agg == "mean" and self.clip is None
+                and self.zscore is None)
+
+    @property
+    def select_m(self) -> int | None:
+        """Krum-family selection size (1 for krum, M for mkrum),
+        None for the non-selecting aggregators."""
+        if self.agg == "krum":
+            return 1
+        if self.agg == "mkrum":
+            return self.mkrum_m
+        return None
+
+
+def _parse_pos_int(spec, token, what: str) -> int:
+    _, _, raw = token.partition(":")
+    try:
+        val = int(raw)
+    except ValueError:
+        val = -1
+    if val < 1:
+        raise ValueError(
+            f"robust_agg={spec!r}: {what} needs a positive integer, "
+            f"got {token!r}")
+    return val
+
+
+def _parse_pos_float(spec, token, what: str, default: float) -> float:
+    import math
+
+    _, _, raw = token.partition(":")
+    try:
+        val = float(raw) if raw else default
+    except ValueError:
+        val = -1.0
+    # `not (val > 0)` so NaN fails too (same rationale as
+    # aggregate.resolve_p_guard's clip radius check)
+    if not (val > 0) or math.isinf(val):
+        raise ValueError(
+            f"robust_agg={spec!r}: {what} must be a positive finite "
+            f"number, got {token!r}")
+    return val
 
 
 def parse_robust_spec(spec) -> RobustSpec:
-    """Parse/validate a ``robust_agg`` spec (string or RobustSpec)."""
+    """Parse/validate a ``robust_agg`` spec (string or RobustSpec).
+
+    With :data:`SPEC_ROUNDTRIP_ENV` set (the test suite does), every
+    accepted spelling is additionally checked against the canonical
+    round-trip contract — see :meth:`RobustSpec.canonical`.
+    """
+    out = _parse_robust_spec(spec)
+    if os.environ.get(SPEC_ROUNDTRIP_ENV):
+        again = _parse_robust_spec(out.canonical())
+        if again != out or again.canonical() != out.canonical():
+            raise AssertionError(
+                f"RobustSpec canonical round-trip broken for "
+                f"{spec!r}: parsed {out}, canonical "
+                f"{out.canonical()!r} re-parses to {again} — this "
+                "would silently split the trainer jit cache")
+    return out
+
+
+def _parse_robust_spec(spec) -> RobustSpec:
     if isinstance(spec, RobustSpec):
         return spec
-    agg, trim, clip = "mean", 0, None
+    agg, trim, mkrum_m, geomed_iters = "mean", 0, 0, 0
+    clip = zscore = None
     agg_set = False
     for token in str(spec).split("+"):
         token = token.strip().lower()
         if not token:
             continue
-        if token in ("mean", "median") or token.startswith("trim"):
+        head = token.split(":", 1)[0]
+        if head in ("mean", "median", "trim", "krum", "mkrum", "geomed"):
             if agg_set:
                 # 'median+mean' must not silently fall back to the
                 # plain average the user thought they opted out of
                 raise ValueError(
                     f"robust_agg={spec!r}: at most one aggregator "
-                    "(mean/median/trim:K) per spec")
+                    "(mean/median/trim:K/krum/mkrum:M/geomed[:T]) "
+                    "per spec")
             agg_set = True
-            if token.startswith("trim"):
-                _, _, k = token.partition(":")
-                try:
-                    trim = int(k)
-                except ValueError:
-                    trim = -1
-                if trim < 1:
-                    raise ValueError(
-                        f"robust_agg={spec!r}: trim needs a positive "
-                        "integer count, e.g. 'trim:1'")
-                agg = "trim"
-            else:
-                agg = token
-        elif token.startswith("clip"):
+            agg = head
+            if head == "trim":
+                trim = _parse_pos_int(spec, token, "trim")
+            elif head == "mkrum":
+                mkrum_m = _parse_pos_int(spec, token, "mkrum")
+            elif head == "geomed":
+                geomed_iters = (_parse_pos_int(spec, token, "geomed")
+                                if ":" in token else GEOMED_ITERS_DEFAULT)
+            elif ":" in token:
+                raise ValueError(
+                    f"robust_agg={spec!r}: {head!r} takes no argument "
+                    f"(got {token!r}; multi-Krum is 'mkrum:M')")
+        elif head == "clip":
             if clip is not None:
                 raise ValueError(
                     f"robust_agg={spec!r}: at most one clip radius "
                     "per spec")
-            _, _, r = token.partition(":")
-            try:
-                radius = float(r) if r else 1.0
-            except ValueError:
-                radius = -1.0
-            import math
-
-            # `not (radius > 0)` so NaN fails too (same rationale as
-            # aggregate.resolve_p_guard's clip radius check)
-            if not (radius > 0) or math.isinf(radius):
+            clip = _parse_pos_float(spec, token, "the clip radius", 1.0)
+        elif head == "quarantine":
+            if zscore is not None:
                 raise ValueError(
-                    f"robust_agg={spec!r}: the clip radius must be a "
-                    "positive finite number, e.g. 'clip:5.0'")
-            clip = radius
+                    f"robust_agg={spec!r}: at most one quarantine "
+                    "threshold per spec")
+            zscore = _parse_pos_float(
+                spec, token, "the quarantine z threshold", 3.0)
         else:
             raise ValueError(
                 f"robust_agg={spec!r}: unknown token {token!r} "
-                "(expected mean, median, trim:K, clip:R, or "
-                "'+'-joined combinations)")
-    return RobustSpec(agg=agg, trim=trim, clip=clip)
+                "(expected mean, median, trim:K, krum, mkrum:M, "
+                "geomed[:T], clip:R, quarantine:Z, or '+'-joined "
+                "combinations)")
+    return RobustSpec(agg=agg, trim=trim, mkrum_m=mkrum_m,
+                      geomed_iters=geomed_iters, clip=clip,
+                      zscore=zscore)
 
 
 def _bcast(v, ndim: int):
@@ -172,6 +280,183 @@ def clip_update_norms(params, stacked, max_norm: float):
         lambda s, g: g + _bcast(scale, s.ndim) * (s - g), stacked, params)
 
 
+def _masked_vector_median(v: jax.Array, present: jax.Array) -> jax.Array:
+    """Median of a ``(J,)`` vector over the present entries (absent
+    sort to ``+inf``; traced present-count indexing, same machinery as
+    :func:`coordinatewise_median`)."""
+    n = jnp.sum(present).astype(jnp.int32)
+    lo = jnp.maximum((n - 1) // 2, 0)
+    hi = jnp.maximum(n // 2, 0)
+    s = jnp.sort(jnp.where(present > 0, v, jnp.inf))
+    return 0.5 * (s[lo] + s[hi])
+
+
+def zscore_quarantine(params, stacked, present: jax.Array, z_max: float,
+                      work_frac: jax.Array | None = None):
+    """Score finite clients by a robust delta-norm z-test (traced).
+
+    The score is the UPPER-TAIL MAD-standardized z
+    ``max(norm_j - median, 0) / (1.4826 * MAD)`` over the present
+    clients' delta L2 norms — robust location/spread rather than
+    mean/std because the classical z is bounded by ``(n-1)/sqrt(n)``
+    (the outlier inflates the std it is scored against), so at
+    federated client counts an arbitrarily extreme update could NEVER
+    exceed the conventional ``Z=3`` threshold. Against median/MAD the
+    honest cluster keeps z small and an outlier's z grows with its
+    distance.
+
+    One-sided by design: a norm-based quarantine exists to stop LARGE
+    pulls on the aggregate; a small-norm update's influence is bounded
+    by its norm, and the legitimate small-norm population — stragglers
+    whose work was truncated — is exactly what the straggler-exact
+    FedNova path (``fednova_effective_weights(tau_frac=...)``) exists
+    to weight correctly rather than discard. A two-sided test would
+    silently quarantine every sufficiently-tight round's stragglers
+    and defeat that normalization.
+
+    ``work_frac`` (per-client ``(J,)`` in ``(0, 1]``, the fault plan's
+    ``tau_frac`` row) normalizes each norm by the local work the
+    client reports having completed, so the z-test compares
+    full-work-EQUIVALENT norms. Without it, a majority-straggle round
+    shifts the median down to the straggler norm and the honest
+    full-work clients become the upper-tail "outliers" (measured:
+    2/6 honest clients quarantined in a 4-straggler round). Using the
+    reported fraction is not an oracle: FedNova's premise is already
+    that clients report their local step counts.
+
+    Returns ``(ok, z)``: ``ok`` the ``(J,)`` 0/1 float mask of present
+    clients with ``z <= z_max`` (absent clients pass — they are
+    already masked out), ``z`` the per-client score (0 on absent
+    clients). The caller folds ``ok`` into the round's present mask —
+    the same mechanism as the non-finite quarantine, so survivor
+    renormalization and FedAMW's masked solve work unchanged.
+
+    Single pass by design: the stats are NOT recomputed over the
+    post-quarantine survivors (iterating would be a different, more
+    aggressive detector). A spread below ``1e-6 * median``
+    (numerically identical updates) scores everyone 0 rather than
+    amplifying float noise into quarantines. Norm-preserving attacks
+    (a pure sign flip) are invisible to ANY norm test — pair with a
+    distance-based aggregator (krum/mkrum/geomed) for those.
+    """
+    norms = client_delta_norms(params, stacked)
+    if work_frac is not None:
+        norms = norms / jnp.clip(work_frac, 1e-6, 1.0)
+    med = _masked_vector_median(norms, present)
+    dev = jnp.abs(norms - med)
+    mad = _masked_vector_median(dev, present)
+    spread = 1.4826 * mad  # MAD -> std of a normal, the standard scale
+    floor = 1e-6 * med + 1e-30
+    z = (present * jnp.maximum(norms - med, 0.0)
+         / jnp.maximum(spread, floor))
+    ok = jnp.where(z <= z_max, 1.0, 0.0)
+    return ok, z
+
+
+def _flat_deltas(params, stacked) -> jax.Array:
+    """Per-client update deltas flattened to a ``(J, P)`` matrix.
+
+    Pairwise client distances are algebraically delta-free (the shared
+    global params cancel in ``x_i - x_j``), but the Gram-expansion the
+    distance computation uses (``sq_i + sq_j - 2 x_i.x_j``) does NOT
+    cancel them in float32 — with params of norm ~1e2 and deltas of
+    norm ~1e-2, rounding on the ~1e4 squared-norm terms would drown
+    the true ~1e-4 distances. Subtracting the global params FIRST
+    keeps every term at delta scale.
+    """
+    return jnp.concatenate([
+        (s - g).reshape(s.shape[0], -1)
+        for s, g in zip(jax.tree.leaves(stacked), jax.tree.leaves(params))
+    ], axis=1)
+
+
+def _masked_mean(stacked, present: jax.Array):
+    """Unweighted mean over the present clients (a
+    ``weighted_average`` with uniform mass on the present set)."""
+    return weighted_average(
+        stacked, present / jnp.maximum(jnp.sum(present), 1.0))
+
+
+def krum_select(params, stacked, present: jax.Array, m: int):
+    """Multi-Krum selection mask (Blanchard et al., 2017): the ``m``
+    best-scored present clients, where a client's score is the summed
+    squared delta distance to its ``q`` closest present peers.
+
+    ``q = n - f - 2`` with ``f = (n - 3) // 2`` — the maximal Byzantine
+    count the ``n >= 2f + 3`` requirement admits, derived from the
+    traced present-count so one compiled program covers every per-round
+    subset. With fewer than 3 present clients the score has no
+    defensive content and every present client is selected (callers'
+    masked-mean fallback semantics).
+
+    Returns the ``(J,)`` 0/1 float selection mask (a subset of
+    ``present``); with ties at the selection boundary ``argsort``'s
+    stable order (lowest client index) decides, deterministically.
+    """
+    x = _flat_deltas(params, stacked)
+    J = x.shape[0]
+    sq = jnp.sum(jnp.square(x), axis=1)
+    d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * (x @ x.T), 0.0)
+    pb = present > 0
+    peer = pb[:, None] & pb[None, :] & ~jnp.eye(J, dtype=bool)
+    d2 = jnp.where(peer, d2, jnp.inf)
+    n = jnp.sum(present).astype(jnp.int32)
+    f = jnp.maximum((n - 3) // 2, 0)
+    q = jnp.clip(n - f - 2, 1, max(J - 1, 1))
+    dsort = jnp.sort(d2, axis=1)
+    idx = jnp.arange(J)
+    # q <= n - 2 for n >= 3, and every present client has n - 1 finite
+    # peer distances, so the gated sum below never touches an inf for
+    # present clients; absent clients' all-inf rows score +inf and can
+    # never be selected
+    score = jnp.sum(jnp.where(idx[None, :] < q, dsort, 0.0), axis=1)
+    sel_count = jnp.minimum(jnp.int32(m), n)
+    order = jnp.argsort(score)
+    selected = jnp.zeros(J, jnp.float32).at[order].set(
+        (idx < sel_count).astype(jnp.float32))
+    return jnp.where(n >= 3, selected, present)
+
+
+def krum_aggregate(params, stacked, present: jax.Array, m: int):
+    """Unweighted mean of the ``m`` Krum-selected clients (classic
+    Krum for ``m=1``, multi-Krum otherwise). Returns
+    ``(aggregate, selected)`` — the selection mask is the round's
+    defense telemetry."""
+    selected = krum_select(params, stacked, present, m)
+    return _masked_mean(stacked, selected), selected
+
+
+def geometric_median(stacked, present: jax.Array, iters: int,
+                     eps: float = 1e-8):
+    """Smoothed Weiszfeld geometric median over the present clients
+    (RFA, Pillutla et al., 2022), unweighted like the other order
+    statistics. ``iters`` is STATIC (an unrolled loop inside the
+    jitted round scan — no data-dependent trip count).
+
+    Returns ``(median, residual)`` where ``residual`` is the global L2
+    distance between the last two iterates — the convergence telemetry
+    the defense report surfaces. With zero present clients the result
+    is garbage; callers gate an all-absent round back to the old
+    params anyway.
+    """
+    v = _masked_mean(stacked, present)
+
+    def step(v):
+        # client_delta_norms broadcasts the iterate against the
+        # stacked client axis — the per-client distances to v
+        dist = client_delta_norms(v, stacked)
+        w = present / jnp.sqrt(jnp.square(dist) + eps * eps)
+        return weighted_average(
+            stacked, w / jnp.maximum(jnp.sum(w), 1e-30))
+
+    for _ in range(max(iters - 1, 0)):
+        v = step(v)
+    v_last = step(v)
+    residual = client_delta_norms(
+        v, jax.tree.map(lambda a: a[None], v_last))[0]
+    return v_last, residual
+
+
 def coordinatewise_median(stacked, present: jax.Array):
     """Per-coordinate median over the present clients (Yin et al.).
 
@@ -215,19 +500,43 @@ def coordinatewise_trimmed_mean(stacked, present: jax.Array, k: int):
 
 
 def make_robust_aggregator(spec: RobustSpec):
-    """``aggregate(stacked, weights, present) -> pytree`` per the spec.
+    """``aggregate(params, stacked, weights, present) -> (pytree,
+    aux)`` per the spec. ``params`` is the round's incoming global
+    model — the distance aggregators score update DELTAS against it
+    (see :func:`_flat_deltas` for why the subtraction matters
+    numerically); the others ignore it.
 
     ``mean`` uses the caller's (already mask-renormalized) weights —
-    the exact ``weighted_average`` reduction; the order-statistic
-    aggregators use the 0/1 ``present`` mask and ignore the weights
-    (see module docstring). Clipping is separate
-    (:func:`clip_update_norms`) and composes with any of them.
+    the exact ``weighted_average`` reduction; the order-statistic /
+    distance aggregators use the 0/1 ``present`` mask and ignore the
+    weights (see module docstring). ``aux`` carries the aggregator's
+    defense telemetry (krum's selection mask, geomed's Weiszfeld
+    residual; empty otherwise). Clipping and the z-score quarantine
+    are separate (:func:`clip_update_norms`,
+    :func:`zscore_quarantine`) and compose with any of them.
     """
     if spec.agg == "median":
-        return lambda stacked, w, present: coordinatewise_median(
-            stacked, present)
+        return lambda params, stacked, w, present: (
+            coordinatewise_median(stacked, present), {})
     if spec.agg == "trim":
         k = spec.trim
-        return lambda stacked, w, present: coordinatewise_trimmed_mean(
-            stacked, present, k)
-    return lambda stacked, w, present: weighted_average(stacked, w)
+        return lambda params, stacked, w, present: (
+            coordinatewise_trimmed_mean(stacked, present, k), {})
+    if spec.agg in ("krum", "mkrum"):
+        m = spec.select_m
+
+        def agg_krum(params, stacked, w, present):
+            out, selected = krum_aggregate(params, stacked, present, m)
+            return out, {"krum_selected": selected}
+
+        return agg_krum
+    if spec.agg == "geomed":
+        iters = spec.geomed_iters
+
+        def agg_geomed(params, stacked, w, present):
+            out, residual = geometric_median(stacked, present, iters)
+            return out, {"geomed_residual": residual}
+
+        return agg_geomed
+    return lambda params, stacked, w, present: (
+        weighted_average(stacked, w), {})

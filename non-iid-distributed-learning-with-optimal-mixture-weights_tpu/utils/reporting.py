@@ -88,6 +88,70 @@ def format_fault_report(name: str, fault_counts: dict) -> str:
             f"(worst round: {s['worst_round_faults']} faulty clients)")
 
 
+def defense_summary(defense: dict) -> dict:
+    """Aggregate a ``defense`` record (the per-round telemetry an
+    active ``robust_agg`` spec attaches to a run's result,
+    ``algorithms.core._round_based``) into run totals: scored-
+    quarantine totals and the hottest z score, krum pick spread
+    (which clients the selection trusted most/least), and the
+    final/worst Weiszfeld residual. Only the keys the spec actually
+    emitted appear."""
+    out = {"robust_agg": defense["robust_agg"]}
+    if "z_quarantined" in defense:
+        zq = np.asarray(defense["z_quarantined"], dtype=int)
+        out["total_z_quarantined"] = int(zq.sum())
+        out["rounds_with_z_quarantine"] = int(np.count_nonzero(zq))
+        out["max_z"] = float(np.max(defense["z_max"]))
+    if "krum_pick_counts" in defense:
+        picks = np.asarray(defense["krum_pick_counts"], dtype=int)
+        # restrict the per-client stats to REAL clients: inert padded
+        # ones (mesh-even packing; 'client_valid' from the run's
+        # sizes) are never present and must not be reported as
+        # "never selected"
+        valid = np.asarray(
+            defense.get("client_valid", np.ones_like(picks)),
+            dtype=bool)
+        idx = np.flatnonzero(valid)
+        vp = picks[idx]
+        out["krum_most_picked"] = (int(idx[vp.argmax()]),
+                                   int(vp.max()))
+        out["krum_least_picked"] = (int(idx[vp.argmin()]),
+                                    int(vp.min()))
+        out["krum_never_picked"] = int(np.sum(vp == 0))
+    if "geomed_residual" in defense:
+        res = np.asarray(defense["geomed_residual"], dtype=float)
+        out["geomed_final_residual"] = float(res[-1])
+        out["geomed_worst_residual"] = float(res.max())
+    return out
+
+
+def format_defense_report(name: str, defense: dict) -> str:
+    """One human-readable line per algorithm for the driver's stdout
+    (``exp.py`` prints this after each defended run), mirroring
+    :func:`format_fault_report` for the defense side: what the spec
+    was, what the scored quarantine caught, whom krum trusted, and
+    whether Weiszfeld converged."""
+    s = defense_summary(defense)
+    bits = [f"{name} defense [{s['robust_agg']}]:"]
+    if "total_z_quarantined" in s:
+        bits.append(
+            f"{s['total_z_quarantined']} z-quarantined over "
+            f"{s['rounds_with_z_quarantine']} rounds "
+            f"(max z {s['max_z']:.2f})")
+    if "krum_most_picked" in s:
+        mi, mc = s["krum_most_picked"]
+        li, lc = s["krum_least_picked"]
+        bits.append(
+            f"krum picks: client {mi} x{mc} most, client {li} x{lc} "
+            f"least, {s['krum_never_picked']} never selected")
+    if "geomed_final_residual" in s:
+        bits.append(
+            f"weiszfeld residual {s['geomed_final_residual']:.2e} "
+            f"final / {s['geomed_worst_residual']:.2e} worst")
+    return " ".join(bits) if len(bits) > 1 else (
+        bits[0] + " active (no per-round telemetry for this spec)")
+
+
 def load_results(path: str) -> dict:
     """Load an ``exp1_{dataset}.pkl`` result dict (driver schema)."""
     with open(path, "rb") as f:

@@ -26,17 +26,24 @@ def test_bench_emits_driver_contract_json():
         BENCH_TORCH_ROUNDS="1", BENCH_AMW_TORCH_ROUNDS="1",
         BENCH_REF_ROUNDS="1", BENCH_AMW_REF_ROUNDS="1",
     )
+    # ambient knobs that would flip the asserted defended-leg shape
+    # (a developer shell may export them)
+    for k in ("BENCH_NO_DEFENDED", "BENCH_DEFENDED",
+              "BENCH_DEFENDED_AGG", "BENCH_DEFENDED_FAULTS"):
+        env.pop(k, None)
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=570,
     )
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [json.loads(ln) for ln in out.stdout.splitlines() if ln]
-    assert len(lines) == 2
-    # headline LAST (the driver records the final line)
+    assert len(lines) == 4
+    # headline LAST (the driver records the final line), and its
+    # kill-safety duplicate printed BEFORE the defended leg's runs
     assert lines[-1]["metric"] == "client_updates_per_sec"
+    assert lines[1] == lines[-1]
     assert lines[0]["metric"] == "fedamw_client_updates_per_sec"
-    for rec in lines:
+    for rec in (lines[0], lines[-1]):
         assert rec["unit"] == "client-updates/s"
         assert rec["value"] > 0
         assert rec["vs_baseline"] > 0
@@ -44,6 +51,16 @@ def test_bench_emits_driver_contract_json():
         assert rec["baseline_arm"] in ("reference-loop", "torch-backend")
         # "xla", a pallas layout, or a FedAMW "kernel+psolver" pair label
         assert rec["impl"] == "xla" or rec["impl"].startswith("pallas")
+    # the defended-round leg (ISSUE 3): fault plane + defense overhead
+    # vs the faulted plain mean, on the same plan
+    dfd = lines[2]
+    assert dfd["metric"] == "defended_round_overhead"
+    assert dfd["value"] > 0
+    assert dfd["unit"] == "x-vs-faulted-mean"
+    assert dfd["defended_updates_per_sec"] > 0
+    assert dfd["faulted_mean_updates_per_sec"] > 0
+    assert "mkrum" in dfd["robust_agg"]
+    assert dfd["platform"] == "cpu"
     # driver-captured roofline fields (PERFORMANCE.md § MFU)
     assert lines[-1]["flops_per_update"] > 0
     assert lines[-1]["achieved_gflops"] > 0
@@ -67,7 +84,8 @@ def test_bench_cpu_fallback_contract():
     # in BASELINE.md for real runs; a developer shell may export them)
     for k in ("BENCH_ROUNDS", "BENCH_CPU_FALLBACK_FULL",
               "BENCH_REF_ROUNDS", "BENCH_NO_PALLAS",
-              "BENCH_NO_REFERENCE"):
+              "BENCH_NO_REFERENCE", "BENCH_DEFENDED",
+              "BENCH_NO_DEFENDED"):
         env.pop(k, None)
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
@@ -75,6 +93,8 @@ def test_bench_cpu_fallback_contract():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "reference arm skipped in CPU fallback" in out.stderr
+    # the defended leg defers to headline kill-safety in fallback
+    assert "defended leg skipped in CPU fallback" in out.stderr
     lines = [json.loads(ln) for ln in out.stdout.splitlines() if ln]
     assert len(lines) == 3
     assert lines[0] == lines[-1]  # kill-safety duplicate of the headline
@@ -83,6 +103,35 @@ def test_bench_cpu_fallback_contract():
     assert lines[-1]["baseline_arm"] == "torch-backend"
     assert lines[1]["metric"] == "fedamw_client_updates_per_sec"
     assert "vs_baseline" not in lines[1]  # no baseline arm in fallback
+
+
+def test_bench_fallback_defended_headline_kill_safety():
+    """BENCH_DEFENDED=1 in the CPU fallback with the FedAMW leg
+    disabled: the headline must print BEFORE the defended leg's four
+    training runs (same kill-safety duplicate as the FedAMW leg), so a
+    driver-side wall-clock kill mid-leg never leaves zero JSON lines
+    (the BENCH_r02-null failure mode)."""
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu", BENCH_FORCE_FALLBACK="1",
+        BENCH_FALLBACK_AMW="0", BENCH_DEFENDED="1",
+        BENCH_CLIENTS="8", BENCH_D="64",
+        BENCH_TORCH_ROUNDS="1",
+    )
+    for k in ("BENCH_ROUNDS", "BENCH_CPU_FALLBACK_FULL",
+              "BENCH_REF_ROUNDS", "BENCH_NO_DEFENDED",
+              "BENCH_DEFENDED_AGG", "BENCH_DEFENDED_FAULTS"):
+        env.pop(k, None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=570,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [json.loads(ln) for ln in out.stdout.splitlines() if ln]
+    assert len(lines) == 3
+    assert lines[0] == lines[-1]  # kill-safety duplicate
+    assert lines[0]["metric"] == "client_updates_per_sec"
+    assert lines[1]["metric"] == "defended_round_overhead"
 
 
 def test_bench_strict_tpu_refuses_cpu_backend():

@@ -74,6 +74,45 @@ def test_exp_driver_extension_flags(tmp_path):
     assert data["test_acc"].shape == (6, 3, 1)
 
 
+def test_exp_driver_defense_and_feature_dtype(tmp_path):
+    """One jax driver run exercising the ISSUE 3 surfaces together:
+    --faults + --robust_agg (defense telemetry printed per algorithm)
+    and --feature_dtype + --save_models (the narrow-feature marker
+    reaches the serving checkpoint, closing the ROADMAP plumbing
+    item)."""
+    ck = tmp_path / "models"
+    out = _run(
+        [os.path.join(REPO, "exp.py"), "--dataset", "digits",
+         "--backend", "jax", "--D", "128", "--num_partitions", "4",
+         "--round", "2", "--local_epoch", "1",
+         "--faults", "corrupt=0.25:scale:20,seed=3",
+         "--robust_agg", "quarantine:5+mkrum:3",
+         "--feature_dtype", "bfloat16",
+         "--save_models", str(ck), "--result_dir", str(tmp_path)],
+        cwd=str(tmp_path),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "faults:" in out.stdout            # fault report line
+    assert "defense [quarantine:5.0+mkrum:3]" in out.stdout
+    assert "krum picks" in out.stdout
+    with open(tmp_path / "exp1_digits.pkl", "rb") as f:
+        data = pickle.load(f)
+    assert np.all(np.isfinite(data["test_acc"]))
+    # the checkpoint is self-contained for bf16-parity serving
+    from fedamw_tpu.utils.checkpoint import load_checkpoint
+    state = load_checkpoint(str(ck / "digits_FedAMW_repeat0"))
+    assert str(state["feature_dtype"]) == "bfloat16"
+    assert "rff_W" in state
+
+
+def test_exp_driver_feature_dtype_rejected_on_torch():
+    out = _run([os.path.join(REPO, "exp.py"), "--dataset", "digits",
+                "--backend", "torch", "--feature_dtype", "bfloat16"],
+               cwd=REPO)
+    assert out.returncode != 0
+    assert "--feature_dtype is a jax-backend extension" in out.stderr
+
+
 def test_results_report_regression_mode():
     """Regression artifacts (acc==0 everywhere; fedcore/evaluate.py)
     are rendered as a final-test-MSE table with best = LOWEST loss and

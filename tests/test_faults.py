@@ -30,8 +30,11 @@ from fedamw_tpu.fedcore.faults import (FaultPlan, FaultSpec,
 from fedamw_tpu.fedcore.robust import (RobustSpec, clip_update_norms,
                                        coordinatewise_median,
                                        coordinatewise_trimmed_mean,
+                                       geometric_median, krum_aggregate,
+                                       krum_select, make_robust_aggregator,
                                        parse_robust_spec,
-                                       sanitize_updates)
+                                       sanitize_updates,
+                                       zscore_quarantine)
 
 pytestmark = pytest.mark.faults
 
@@ -186,21 +189,395 @@ def test_trimmed_mean_drops_extremes_and_falls_back():
     ("clip:5", RobustSpec(clip=5.0)),
     ("clip:5+trim:1", RobustSpec(agg="trim", trim=1, clip=5.0)),
     ("CLIP:2.5 + median", RobustSpec(agg="median", clip=2.5)),
+    ("krum", RobustSpec(agg="krum")),
+    ("mkrum:4", RobustSpec(agg="mkrum", mkrum_m=4)),
+    ("geomed", RobustSpec(agg="geomed", geomed_iters=8)),
+    ("geomed:3", RobustSpec(agg="geomed", geomed_iters=3)),
+    ("quarantine:2.5", RobustSpec(zscore=2.5)),
+    ("quarantine", RobustSpec(zscore=3.0)),
+    ("quarantine:3+mkrum:6",
+     RobustSpec(agg="mkrum", mkrum_m=6, zscore=3.0)),
+    ("clip:5+quarantine:2+geomed:4",
+     RobustSpec(agg="geomed", geomed_iters=4, clip=5.0, zscore=2.0)),
 ])
 def test_parse_robust_spec(spec, want):
     assert parse_robust_spec(spec) == want
 
 
 @pytest.mark.parametrize("bad", ["trim", "trim:0", "clip:0", "clip:nan",
-                                 "clip:inf", "median+trim:1", "krum",
+                                 "clip:inf", "median+trim:1",
                                  "median+mean", "trim:2+mean",
-                                 "clip:5+clip:0.1"])
+                                 "clip:5+clip:0.1", "krum:2", "mkrum",
+                                 "mkrum:0", "geomed:0", "geomed:x",
+                                 "quarantine:0", "quarantine:nan",
+                                 "quarantine:inf", "krum+mkrum:2",
+                                 "quarantine:2+quarantine:3", "bogus"])
 def test_parse_robust_spec_rejects(bad):
     """Includes the silent-fallback spellings: 'median+mean' must not
     quietly run the plain average the user opted out of, and duplicate
-    clip radii must not last-win."""
+    clip radii / quarantine thresholds must not last-win."""
     with pytest.raises(ValueError):
         parse_robust_spec(bad)
+
+
+# every accepted spelling the suite knows about — the canonical
+# round-trip sweep below AND the conftest-level guard
+# (FEDAMW_SPEC_ROUNDTRIP_CHECK, enabled suite-wide) both walk it
+ACCEPTED_SPELLINGS = [
+    "mean", "median", "trim:1", "trim:3", "clip", "clip:5",
+    "clip:0.5+median", "clip:5+trim:1", "CLIP:2.5 + median",
+    "krum", "mkrum:1", "mkrum:4", "geomed", "geomed:3",
+    "quarantine", "quarantine:2.5", "quarantine:3+mkrum:6",
+    "clip:5+quarantine:2+geomed:4", "mkrum:2+clip:1+quarantine:1.5",
+]
+
+
+@pytest.mark.parametrize("spelling", ACCEPTED_SPELLINGS)
+def test_robust_spec_canonical_round_trip(spelling):
+    """parse(canonical(parse(s))) == parse(s) and canonical() is a
+    fixed point — otherwise an accepted spelling and its canonical
+    form would key DIFFERENT entries in the trainer jit cache
+    (core._cached_round_trainer memoizes on the canonical string) and
+    silently recompile per spelling."""
+    spec = parse_robust_spec(spelling)
+    canon = spec.canonical()
+    assert parse_robust_spec(canon) == spec
+    assert parse_robust_spec(canon).canonical() == canon
+
+
+def test_roundtrip_guard_is_armed_in_suite():
+    """conftest exports FEDAMW_SPEC_ROUNDTRIP_CHECK=1, so EVERY
+    parse_robust_spec call anywhere in the suite (fixtures, trainers,
+    drivers) verifies the round-trip contract — a new token with a
+    drifting canonical spelling fails loudly at first parse."""
+    import os
+
+    from fedamw_tpu.fedcore.robust import SPEC_ROUNDTRIP_ENV
+    assert os.environ.get(SPEC_ROUNDTRIP_ENV)
+
+
+# -- defense primitives: z-quarantine, krum, geomed -------------------
+
+def test_zscore_quarantine_flags_scaled_outlier():
+    """A 10x-norm outlier z-scores far beyond any sane threshold under
+    the median/MAD test (the classical mean/std z is BOUNDED by
+    (n-1)/sqrt(n) ~ 2.2 here — it could never fire at Z=3; the robust
+    z is why quarantine:3 works at federated client counts)."""
+    rng = np.random.RandomState(0)
+    J, P = 6, 8
+    g = {"w": np.zeros((P,), np.float32)}
+    deltas = rng.randn(J, P).astype(np.float32)
+    deltas /= np.linalg.norm(deltas, axis=1, keepdims=True)
+    deltas *= (1.0 + 0.1 * rng.randn(J, 1).astype(np.float32))
+    deltas[0] *= 10.0
+    stacked = {"w": deltas}
+    ok, z = zscore_quarantine(g, stacked, np.ones(J, np.float32), 3.0)
+    ok, z = np.asarray(ok), np.asarray(z)
+    assert ok[0] == 0.0 and z[0] > 3.0
+    np.testing.assert_array_equal(ok[1:], 1.0)
+
+
+def test_zscore_quarantine_is_upper_tail_only():
+    """A small-norm update (a straggler's truncated work) must NOT be
+    quarantined — its pull on the aggregate is bounded by its norm,
+    and the straggler-exact FedNova path exists to weight it, not
+    discard it. Only the large-norm tail quarantines."""
+    rng = np.random.RandomState(7)
+    J, P = 8, 10
+    g = {"w": np.zeros((P,), np.float32)}
+    deltas = rng.randn(J, P).astype(np.float32)
+    deltas /= np.linalg.norm(deltas, axis=1, keepdims=True)
+    deltas *= (1.0 + 0.05 * rng.randn(J, 1).astype(np.float32))
+    deltas[0] *= 0.25   # straggler: frac=0.25 of the work
+    deltas[1] *= 10.0   # attacker: 10x norm
+    ok, z = zscore_quarantine(g, {"w": deltas},
+                              np.ones(J, np.float32), 5.0)
+    ok = np.asarray(ok)
+    assert ok[0] == 1.0  # the straggler survives
+    assert ok[1] == 0.0  # the attacker does not
+    assert float(np.asarray(z)[0]) == 0.0  # below-median scores 0
+
+
+def test_fednova_straggler_survives_quarantine(setup8):
+    """The pairing the straggler-exact tau was built for: FedNova with
+    real stragglers AND quarantine:5 — the stragglers' partial work is
+    kept (zero z-quarantines) and normalized exactly, not discarded."""
+    R, J = KW["round"], setup8.num_clients
+    res = FedNova(setup8, faults=target_plan(R, J, "straggle", 2,
+                                             frac=0.25),
+                  robust_agg="quarantine:5", **KW)
+    assert np.all(np.isfinite(res["test_loss"]))
+    assert res["fault_counts"]["straggled"].sum() == R
+    assert res["defense"]["z_quarantined"].sum() == 0
+
+
+def test_majority_straggle_round_spares_honest_clients(setup8):
+    """The work-fraction normalization contract: with a MAJORITY of
+    clients straggling, the raw-norm median would sit at the straggler
+    norm and the honest full-work clients would look like upper-tail
+    outliers. Scoring full-work-equivalent norms (norms / tau_frac,
+    the fraction FedNova already assumes clients report) keeps every
+    honest client in the round."""
+    R, J = KW["round"], setup8.num_clients
+    z = np.zeros((R, J), np.float32)
+    straggle = z.copy()
+    scale = np.ones((R, J), np.float32)
+    straggle[:, :J - 2] = 1          # 6 of 8 straggle...
+    scale[:, :J - 2] = 0.25          # ...at a quarter of the work
+    plan = FaultPlan(z, straggle, z.copy(), scale, z.copy(), z.copy())
+    res = FedAvg(setup8, faults=plan, robust_agg="quarantine:5", **KW)
+    assert np.all(np.isfinite(res["test_loss"]))
+    assert res["fault_counts"]["straggled"].sum() == R * (J - 2)
+    assert res["defense"]["z_quarantined"].sum() == 0
+
+
+def test_zscore_quarantine_work_frac_normalizes(setup8):
+    """Unit-level: under work_frac every 0.25x-work straggler scores
+    as its full-work-equivalent self (z ~ 0, even when stragglers are
+    the majority), while a 20x attacker reporting full work still
+    quarantines."""
+    rng = np.random.RandomState(11)
+    J, P = 6, 12
+    g = {"w": np.zeros((P,), np.float32)}
+    deltas = rng.randn(J, P).astype(np.float32)
+    deltas /= np.linalg.norm(deltas, axis=1, keepdims=True)
+    work = np.ones(J, np.float32)
+    # clients 0-3 straggle at 0.25; client 4 honest; client 5 scales 20x
+    deltas[:4] *= 0.25
+    work[:4] = 0.25
+    deltas[5] *= 20.0
+    ok, z = zscore_quarantine(g, {"w": deltas},
+                              np.ones(J, np.float32), 5.0,
+                              work_frac=work)
+    ok = np.asarray(ok)
+    np.testing.assert_array_equal(ok[:5], 1.0)  # stragglers + honest
+    assert ok[5] == 0.0                          # attacker
+
+
+def test_zscore_quarantine_ignores_absent_and_uniform():
+    g = {"w": np.zeros((4,), np.float32)}
+    stacked = {"w": np.stack([np.full(4, 1.0), np.full(4, 1.0),
+                              np.full(4, 100.0), np.full(4, 1.0)]
+                             ).astype(np.float32)}
+    # the 100x client is ABSENT: it must neither be scored nor pollute
+    # the median/MAD of the present set
+    present = np.asarray([1, 1, 0, 1], np.float32)
+    ok, z = zscore_quarantine(g, stacked, present, 3.0)
+    np.testing.assert_array_equal(np.asarray(ok), [1, 1, 1, 1])
+    assert float(np.asarray(z)[2]) == 0.0
+    # numerically identical present updates: z is exactly 0 everywhere
+    # (the spread floor), not noise amplified into quarantines
+    ok2, z2 = zscore_quarantine(
+        g, {"w": np.ones((4, 4), np.float32)}, present, 3.0)
+    np.testing.assert_array_equal(np.asarray(z2), 0.0)
+
+
+def test_krum_select_excludes_the_outlier():
+    rng = np.random.RandomState(1)
+    J, P = 8, 10
+    g = {"w": np.zeros(P, np.float32)}
+    honest = rng.randn(P).astype(np.float32)
+    x = honest[None] + 0.05 * rng.randn(J, P).astype(np.float32)
+    x[3] = -5.0 * honest  # far from the honest cluster
+    sel = np.asarray(krum_select(g, {"w": x},
+                                 np.ones(J, np.float32), J - 1))
+    assert sel[3] == 0.0 and sel.sum() == J - 1
+    # classic krum (m=1) picks ONE honest client
+    sel1 = np.asarray(krum_select(g, {"w": x},
+                                  np.ones(J, np.float32), 1))
+    assert sel1.sum() == 1 and sel1[3] == 0.0
+    # absent clients can never be selected
+    present = np.ones(J, np.float32)
+    present[0] = 0.0
+    sel2 = np.asarray(krum_select(g, {"w": x}, present, J))
+    assert sel2[0] == 0.0
+
+
+def test_krum_scores_deltas_not_raw_params():
+    """The float32 contract behind _flat_deltas: with a LARGE shared
+    global model (norm ~1e2) and tiny honest deltas (~1e-2), the
+    Gram-expanded pairwise distances on raw stacked params would be
+    pure rounding noise (~1e-3 absolute, an order above the true
+    ~1e-4 distances). Scoring deltas keeps a modest outlier reliably
+    excluded."""
+    rng = np.random.RandomState(6)
+    J, P = 8, 50
+    big = (10.0 * rng.randn(P)).astype(np.float32)  # ||g|| ~ 70
+    g = {"w": big}
+    d = rng.randn(J, P).astype(np.float32)
+    d /= np.linalg.norm(d, axis=1, keepdims=True) * 100.0  # ~1e-2
+    d[5] *= -8.0  # modest outlier, far only at DELTA scale
+    sel = np.asarray(krum_select(g, {"w": big[None] + d},
+                                 np.ones(J, np.float32), J - 1))
+    assert sel[5] == 0.0 and sel.sum() == J - 1
+
+
+def test_krum_small_round_falls_back_to_present():
+    """With fewer than 3 present clients the Krum score has no
+    defensive content; every present client is selected (masked-mean
+    fallback, mirroring trimmed-mean's small-n behavior)."""
+    g = {"w": np.zeros(1, np.float32)}
+    x = {"w": np.asarray([[0.0], [1.0], [50.0]], np.float32)}
+    present = np.asarray([1, 0, 1], np.float32)
+    sel = np.asarray(krum_select(g, x, present, 1))
+    np.testing.assert_array_equal(sel, present)
+
+
+def test_geomed_matches_median_against_outlier():
+    """The geometric median of a tight cluster + one far outlier lands
+    in the cluster, and the Weiszfeld residual telemetry shrinks to
+    ~0 by the default iteration count."""
+    rng = np.random.RandomState(2)
+    J, P = 9, 6
+    center = rng.randn(P).astype(np.float32)
+    x = center[None] + 0.01 * rng.randn(J, P).astype(np.float32)
+    x[4] = center + 1000.0
+    out, residual = geometric_median({"w": x}, np.ones(J, np.float32),
+                                     iters=12)
+    assert np.linalg.norm(np.asarray(out["w"]) - center) < 0.1
+    assert float(residual) < 1e-2
+    # absent clients never vote: mask the outlier out and the result
+    # stays in the cluster with everyone else present
+    present = np.ones(J, np.float32)
+    present[4] = 0.0
+    out2, _ = geometric_median({"w": x}, present, iters=12)
+    assert np.linalg.norm(np.asarray(out2["w"]) - center) < 0.1
+
+
+# -- aggregator contracts (ISSUE 3 satellite) -------------------------
+
+CONTRACT_SPECS = ("mean", "median", "trim:1", "krum", "mkrum:4",
+                  "geomed", "geomed:16")
+
+
+def test_clean_round_every_aggregator_near_weighted_mean():
+    """On a clean all-present round with a tight honest cluster, every
+    aggregator is a consistent estimator of the same center: each
+    lands within the cluster spread of the weighted mean."""
+    rng = np.random.RandomState(3)
+    J, P = 8, 20
+    base = rng.randn(P).astype(np.float32)
+    stacked = {"w": (base[None]
+                     + 0.01 * rng.randn(J, P)).astype(np.float32)}
+    w = np.full(J, 1.0 / J, np.float32)
+    present = np.ones(J, np.float32)
+    from fedamw_tpu.fedcore.aggregate import weighted_average
+    g = {"w": np.zeros(P, np.float32)}
+    want = np.asarray(weighted_average(stacked, w)["w"])
+    for spec in CONTRACT_SPECS:
+        agg = make_robust_aggregator(parse_robust_spec(spec))
+        out, _aux = agg(g, stacked, w, present)
+        np.testing.assert_allclose(np.asarray(out["w"]), want,
+                                   atol=0.05, err_msg=spec)
+
+
+def test_sign_flip_attackers_defended_norm_bounded_mean_diverges():
+    """f=3 of 10 clients report a scaled sign flip: the plain mean is
+    dragged far from the honest center while every defended
+    aggregator stays within the honest cluster."""
+    rng = np.random.RandomState(4)
+    J, P, f = 10, 30, 3
+    honest = rng.randn(P).astype(np.float32)
+    honest /= np.linalg.norm(honest) / 5.0
+    x = honest[None] + 0.05 * rng.randn(J, P).astype(np.float32)
+    x[:f] = -30.0 * honest[None] + 0.05 * rng.randn(f, P)
+    stacked = {"w": x.astype(np.float32)}
+    w = np.full(J, 1.0 / J, np.float32)
+    present = np.ones(J, np.float32)
+    from fedamw_tpu.fedcore.aggregate import weighted_average
+    mean_err = np.linalg.norm(
+        np.asarray(weighted_average(stacked, w)["w"]) - honest)
+    assert mean_err > 10.0  # the undefended mean diverges
+    g = {"w": np.zeros(P, np.float32)}
+    for spec in ("median", "trim:3", "krum", "mkrum:5", "geomed"):
+        agg = make_robust_aggregator(parse_robust_spec(spec))
+        out, _aux = agg(g, stacked, w, present)
+        err = np.linalg.norm(np.asarray(out["w"]) - honest)
+        assert err < 1.0, (spec, err, mean_err)
+
+
+def test_krum_aggregate_returns_selection_telemetry():
+    rng = np.random.RandomState(5)
+    x = {"w": rng.randn(6, 4).astype(np.float32)}
+    out, selected = krum_aggregate({"w": np.zeros(4, np.float32)}, x,
+                                   np.ones(6, np.float32), 3)
+    assert np.asarray(selected).sum() == 3
+    picked = np.asarray(selected) > 0
+    np.testing.assert_allclose(
+        np.asarray(out["w"]), np.asarray(x["w"])[picked].mean(0),
+        rtol=1e-5)
+
+
+# -- straggler-exact FedNova ------------------------------------------
+
+def test_fednova_tau_frac_rescales_effective_weights():
+    from fedamw_tpu.fedcore.aggregate import fednova_effective_weights
+    sizes = np.asarray([100.0, 200.0, 0.0, 50.0], np.float32)
+    p = np.asarray([0.3, 0.4, 0.0, 0.3], np.float32)
+    frac = np.asarray([1.0, 0.5, 1.0, 1.0], np.float32)
+    full = np.asarray(fednova_effective_weights(sizes, p, 2, 32))
+    exact = np.asarray(fednova_effective_weights(sizes, p, 2, 32,
+                                                 tau_frac=frac))
+    # manual FedNova: tau scaled by the work actually done
+    tau = sizes * 2 / 32 * frac
+    tau_eff = float(np.sum(tau * p))
+    want = np.where(tau > 0, p * tau_eff / np.where(tau > 0, tau, 1.0),
+                    0.0)
+    np.testing.assert_allclose(exact, want, rtol=1e-6)
+    # the straggler's PER-STEP weight grows (fewer local steps ->
+    # larger normalized weight), and padded clients stay inert
+    assert exact[1] > full[1]
+    assert exact[2] == 0.0
+    # an all-ones fraction is bitwise the full-work weights
+    ones = np.asarray(fednova_effective_weights(
+        sizes, p, 2, 32, tau_frac=np.ones(4, np.float32)))
+    np.testing.assert_array_equal(ones, full)
+
+
+def test_fault_plan_rows_carry_tau_frac():
+    """rows() exposes the per-round work fraction: straggle_frac on
+    straggling cells, 1.0 elsewhere — including corrupt cells, whose
+    scale is an adversarial multiplier, NOT work done."""
+    spec = FaultSpec(straggle=0.4, straggle_frac=0.25, corrupt=0.3,
+                     corrupt_mode="scale", corrupt_scale=7.0, seed=1)
+    plan = FaultPlan.build(spec, rounds=6, num_clients=10)
+    rows = plan.rows(0, 6)
+    assert len(rows) == 5
+    tau_frac = np.asarray(rows[4])
+    np.testing.assert_array_equal(tau_frac[plan.straggle > 0], 0.25)
+    np.testing.assert_array_equal(tau_frac[plan.straggle == 0], 1.0)
+    assert plan.corrupt.sum() > 0  # the distinction above was exercised
+
+
+def test_fednova_full_work_straggler_is_bitwise_clean(setup8):
+    """straggle_frac=1.0 means the full local work was done: the
+    injection is a bitwise no-op AND the straggler-exact tau path
+    multiplies by exactly 1.0, so the faulted FedNova run equals the
+    clean one array-for-array — pinning that the tau_frac wiring
+    cannot perturb a clean round."""
+    R, J = KW["round"], setup8.num_clients
+    clean = FedNova(setup8, return_state=True, **KW)
+    faulted = FedNova(setup8, faults=target_plan(R, J, "straggle", 2,
+                                                 frac=1.0),
+                      return_state=True, **KW)
+    np.testing.assert_array_equal(np.asarray(faulted["params"]["w"]),
+                                  np.asarray(clean["params"]["w"]))
+    np.testing.assert_array_equal(faulted["test_acc"],
+                                  clean["test_acc"])
+    assert faulted["fault_counts"]["straggled"].sum() == R
+
+
+def test_fednova_straggler_exact_tau_changes_the_aggregate(setup8):
+    """A true straggler (frac<1) must flow through the tau-exact
+    normalization: the run stays finite and differs from clean."""
+    R, J = KW["round"], setup8.num_clients
+    clean = FedNova(setup8, return_state=True, **KW)
+    strag = FedNova(setup8, faults=target_plan(R, J, "straggle", 2,
+                                               frac=0.25),
+                    return_state=True, **KW)
+    assert np.all(np.isfinite(strag["test_loss"]))
+    assert not np.array_equal(np.asarray(strag["params"]["w"]),
+                              np.asarray(clean["params"]["w"]))
 
 
 # -- end-to-end: injection, quarantine, equivalences ------------------
@@ -299,15 +676,74 @@ def test_fednova_accepts_faults(setup8):
 
 
 def test_sign_flip_defended_by_median_and_clip(setup8):
-    """Finite corruption (sign flip) sails through the quarantine by
-    design; the opt-in robust aggregators are the defense."""
+    """Finite corruption (sign flip) sails through the non-finite
+    quarantine by design (and through the NORM z-test too — a sign
+    flip is norm-preserving); the opt-in robust aggregators are the
+    defense."""
     R, J = KW["round"], setup8.num_clients
     plan = target_plan(R, J, "sign", 0)
-    for agg in ("median", "clip:1+trim:1"):
+    for agg in ("median", "clip:1+trim:1", "krum", "mkrum:4",
+                "geomed:4"):
         res = FedAvg(setup8, faults=plan, robust_agg=agg, **KW)
         assert np.all(np.isfinite(res["test_loss"])), agg
         assert res["fault_counts"]["corrupted"].sum() == R
         assert res["fault_counts"]["quarantined"].sum() == 0
+
+
+def test_scored_quarantine_catches_scale_attack(setup8):
+    """A finite 25x-scaled update slips the non-finite quarantine but
+    the delta-norm z-test flags it every round; the defense telemetry
+    reports the catch and the quarantined client's weight renormalizes
+    away exactly like a drop (array-equal to the clean-drop run)."""
+    R, J = KW["round"], setup8.num_clients
+    plan = target_plan(R, J, "sign", 2)
+    # a scale corruption: reuse the sign-plan plumbing with scale=25
+    plan.scale[:, 2] = 25.0
+    # Z=5: honest digits clients top out near z~3.3 (real Dirichlet
+    # heterogeneity), the 25x attacker lands at z>50 — 5 splits them
+    # with a wide margin on both sides
+    res = FedAvg(setup8, faults=plan, robust_agg="quarantine:5",
+                 return_state=True, **KW)
+    assert np.all(np.isfinite(res["test_loss"]))
+    d = res["defense"]
+    assert d["robust_agg"] == "quarantine:5.0"
+    np.testing.assert_array_equal(d["z_quarantined"], np.full(R, 1))
+    assert float(np.max(d["z_max"])) > 5.0
+    drop = FedAvg(setup8, faults=target_plan(R, J, "drop", 2),
+                  return_state=True, **KW)
+    np.testing.assert_array_equal(np.asarray(res["params"]["w"]),
+                                  np.asarray(drop["params"]["w"]))
+    np.testing.assert_array_equal(res["test_acc"], drop["test_acc"])
+
+
+def test_scored_quarantine_spares_clean_rounds(setup8):
+    """quarantine:Z without faults: no honest digits client should
+    z-score past a loose threshold, so the run is bitwise the clean
+    run (same weights, same present set) and telemetry shows zero."""
+    clean = FedAvg(setup8, return_state=True, **KW)
+    res = FedAvg(setup8, robust_agg="quarantine:50", return_state=True,
+                 **KW)
+    assert res["defense"]["z_quarantined"].sum() == 0
+    np.testing.assert_array_equal(np.asarray(res["params"]["w"]),
+                                  np.asarray(clean["params"]["w"]))
+    assert "fault_counts" not in res  # no plan, no fault report
+
+
+def test_defended_aggregators_emit_telemetry(setup8):
+    """mkrum's selection counts and geomed's Weiszfeld residuals reach
+    the result's defense record with the documented shapes."""
+    R, J = KW["round"], setup8.num_clients
+    res = FedAvg(setup8, robust_agg="mkrum:4", **KW)
+    d = res["defense"]
+    assert d["krum_selected"].shape == (R, J)
+    np.testing.assert_array_equal(d["krum_selected"].sum(1),
+                                  np.full(R, 4))
+    np.testing.assert_array_equal(d["krum_pick_counts"],
+                                  d["krum_selected"].sum(0))
+    res = FedAvg(setup8, robust_agg="geomed:6", **KW)
+    d = res["defense"]
+    assert d["geomed_residual"].shape == (R,)
+    assert np.all(np.isfinite(d["geomed_residual"]))
 
 
 def test_robust_agg_without_faults_runs(setup8):
@@ -346,6 +782,43 @@ def test_fedamw_dropout_zero_mass_and_masked_simplex(setup8,
     assert np.all(np.isfinite(guarded["test_loss"]))
 
 
+def test_fedamw_mkrum_zero_mass_on_attacker_and_beats_mean(setup8):
+    """The ISSUE 3 acceptance contract: under a persistent sign-flip
+    attacker, FedAMW + mkrum quarantines the attacker out of the
+    mixture (selection folds into the present mask BEFORE the p-solve,
+    so the attacker's learned mass is exactly zero and its picks stay
+    at zero) and ends with better validation accuracy than FedAMW +
+    mean on the same seed and plan.
+
+    lr_p is deliberately SLOW (1e-4) here: at hot mixture rates the
+    unconstrained p-solver is itself a defense — it learns a NEGATIVE
+    weight for the sign-flipped client, re-flipping the poison back
+    into signal, and FedAMW+mean can even beat clean (measured: p[2]
+    -> -0.65 at lr_p=1e-3). The defense plane is for the regimes where
+    p cannot adapt within the horizon (slow lr_p, the simplex guard,
+    or attacks on the solve itself) — README 'Choosing a robust
+    aggregator'."""
+    J = setup8.num_clients
+    kw = dict(lr=0.5, epoch=1, round=3, seed=0, lr_mode="constant",
+              lambda_reg=1e-4, lr_p=1e-4)
+    R = kw["round"]
+    plan = target_plan(R, J, "sign", 2)
+    defended = FedAMW(setup8, faults=plan, robust_agg=f"mkrum:{J - 1}",
+                      return_state=True, **kw)
+    assert np.all(np.isfinite(defended["test_loss"]))
+    p = np.asarray(defended["p"])
+    assert float(p[2]) == 0.0  # exactly zero learned mass
+    picks = defended["defense"]["krum_pick_counts"]
+    assert picks[2] == 0  # never selected
+    assert picks.sum() == R * (J - 1)
+    undefended = FedAMW(setup8, faults=plan, return_state=True, **kw)
+    assert (float(defended["test_acc"][-1])
+            > float(undefended["test_acc"][-1]))
+    # the attacker keeps nonzero mass in the undefended run — the
+    # defended zero is the selection's doing, not the solver's
+    assert float(np.asarray(undefended["p"])[2]) != 0.0
+
+
 # -- zero-recompile + resume contracts --------------------------------
 
 def test_fault_plan_change_adds_no_recompile(setup8):
@@ -360,6 +833,30 @@ def test_fault_plan_change_adds_no_recompile(setup8):
     assert core._LAST_TRAIN_FN is fn  # same memoized trainer
     if size0 is not None:
         assert fn._cache_size() == size0  # same compiled program
+
+
+@pytest.mark.parametrize("agg", ["krum", "mkrum:3", "geomed:4",
+                                 "quarantine:3",
+                                 "clip:5+quarantine:3+mkrum:6"])
+def test_new_defense_tokens_compile_one_round_program(setup8, agg):
+    """ISSUE 3 acceptance: every new spec token compiles exactly one
+    round program across varying per-round fault plans — the defense
+    is program STRUCTURE, the plan is data."""
+    FedAvg(setup8, faults="corrupt=0.3:sign,seed=1", robust_agg=agg,
+           **KW)
+    fn = core._LAST_TRAIN_FN
+    size0 = fn._cache_size() if hasattr(fn, "_cache_size") else None
+    FedAvg(setup8, faults="corrupt=0.1:scale:9,drop=0.2,seed=77",
+           robust_agg=agg, **KW)
+    assert core._LAST_TRAIN_FN is fn
+    if size0 is not None:
+        assert fn._cache_size() == size0
+    # equivalent spellings share the SAME memoized trainer (canonical
+    # spec keys the cache): e.g. 'geomed' == 'geomed:8'
+    if agg == "geomed:4":
+        FedAvg(setup8, faults="corrupt=0.1:sign,seed=3",
+               robust_agg="GEOMED:4", **KW)
+        assert core._LAST_TRAIN_FN is fn
 
 
 def test_faults_resume_replays_identical_plan(setup8):
@@ -410,3 +907,36 @@ def test_fault_counts_and_report(setup8):
     assert s["rounds"] == KW["round"]
     line = format_fault_report("FedAvg", counts)
     assert "FedAvg" in line and f"{s['total_dropped']} dropped" in line
+
+
+def test_defense_summary_and_report(setup8):
+    R, J = KW["round"], setup8.num_clients
+    plan = target_plan(R, J, "sign", 1)
+    plan.scale[:, 1] = 30.0
+    res = FedAvg(setup8, faults=plan,
+                 robust_agg="quarantine:5+mkrum:6", **KW)
+    from fedamw_tpu.utils.reporting import (defense_summary,
+                                            format_defense_report)
+    d = res["defense"]
+    s = defense_summary(d)
+    assert s["robust_agg"] == "quarantine:5.0+mkrum:6"
+    assert s["total_z_quarantined"] == d["z_quarantined"].sum() == R
+    assert s["max_z"] > 5.0
+    assert s["krum_least_picked"][1] <= s["krum_most_picked"][1]
+    line = format_defense_report("FedAvg", d)
+    assert "FedAvg defense" in line
+    assert "z-quarantined" in line and "krum picks" in line
+
+    res_g = FedAvg(setup8, robust_agg="geomed:4", **KW)
+    line_g = format_defense_report("FedAvg", res_g["defense"])
+    assert "weiszfeld residual" in line_g
+
+    # padded (sizes==0) clients are masked out of the per-client pick
+    # stats: a padding column with 0 picks must not be named "least
+    # picked" / counted as "never selected"
+    fake = {"robust_agg": "mkrum:2",
+            "krum_pick_counts": np.asarray([3, 1, 2, 0]),
+            "client_valid": np.asarray([1, 1, 1, 0])}
+    sf = defense_summary(fake)
+    assert sf["krum_least_picked"] == (1, 1)
+    assert sf["krum_never_picked"] == 0
