@@ -22,6 +22,7 @@ torch, so the reported speedup is conservative.
 Prints JSON lines (headline metric LAST):
     {"metric": "fedamw_client_updates_per_sec", ...}
     {"metric": "defended_round_overhead", ...}   (fault plane vs mean)
+    {"metric": "reputation_round_overhead", ...} (stateful rep vs mean)
     {"metric": "client_updates_per_sec", "value": ..., "unit": "...",
      "vs_baseline": <speedup over torch-CPU>}
 
@@ -58,7 +59,10 @@ BENCH_AMW_REF_ROUNDS (default 2), BENCH_NO_REFERENCE (skip the
 reference arm), BENCH_NO_PALLAS, BENCH_FALLBACK_AMW=1/0,
 BENCH_CPU_FALLBACK_FULL=1, BENCH_NO_DEFENDED / BENCH_DEFENDED=1 /
 BENCH_DEFENDED_AGG / BENCH_DEFENDED_FAULTS (the ISSUE 3
-defense-overhead leg; see bench_defended), BENCH_PROFILE
+defense-overhead leg; see bench_defended), BENCH_NO_REPUTATION /
+BENCH_REPUTATION_AGG / BENCH_REPUTATION_FAULTS (the ISSUE 4 stateful
+reputation-overhead leg, emitted on BOTH the full and fallback paths;
+see bench_reputation), BENCH_PROFILE
 (set to a directory to capture a jax.profiler trace of the timed run).
 """
 
@@ -251,6 +255,55 @@ def bench_defended(ds, D, rounds, num_clients, platform):
         "value": round(overhead, 3),
         "unit": "x-vs-faulted-mean",
         "defended_updates_per_sec": round(dfd_ups, 2),
+        "faulted_mean_updates_per_sec": round(mean_ups, 2),
+        "robust_agg": agg,
+        "faults": faults,
+        "platform": platform,
+    }
+
+
+def bench_reputation(ds, D, rounds, num_clients, platform):
+    """CPU-safe reputation-round leg (ISSUE 4): time FedAvg under one
+    sign-flip fault plan twice — plain mean vs the stateful reputation
+    spec (cross-round EWMA + directional scores + auto-tuned z
+    threshold riding the scan carry) — and report the reputation
+    plane's round overhead. Both legs run the faulted graph, so the
+    ratio isolates the STATEFUL defense cost (directional cosines are
+    ``O(JP)`` + a coordinate-wise median, vs krum's ``O(J^2 P)`` in
+    the defended leg), not the fault-injection plumbing. Returns the
+    JSON record or None on failure (a side leg must never cost the
+    headline metric). Emitted on BOTH the full and the CPU-fallback
+    paths — the fallback's headline kill-safety duplicate prints
+    first.
+
+    Env: BENCH_NO_REPUTATION=1 skips, BENCH_REPUTATION_AGG overrides
+    the spec (default rep:0.9:0.2+quarantine:auto),
+    BENCH_REPUTATION_FAULTS the plan (default corrupt=0.1:sign,seed=7).
+    """
+    if os.environ.get("BENCH_NO_REPUTATION"):
+        return None
+    agg = os.environ.get("BENCH_REPUTATION_AGG",
+                         "rep:0.9:0.2+quarantine:auto")
+    faults = os.environ.get("BENCH_REPUTATION_FAULTS",
+                            "corrupt=0.1:sign,seed=7")
+    try:
+        mean_ups, mean_acc, mean_dt = bench_jax(
+            ds, D, rounds, faults=faults, robust_agg="mean")
+        rep_ups, rep_acc, rep_dt = bench_jax(
+            ds, D, rounds, faults=faults, robust_agg=agg)
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"# reputation leg failed: {e!r}", file=sys.stderr)
+        return None
+    overhead = mean_ups / rep_ups if rep_ups > 0 else float("inf")
+    print(f"# reputation leg [{agg}] under {faults}: {rep_ups:.1f} "
+          f"updates/s (acc {rep_acc:.2f}) vs faulted-mean "
+          f"{mean_ups:.1f} updates/s (acc {mean_acc:.2f}) -> "
+          f"{overhead:.2f}x overhead", file=sys.stderr)
+    return {
+        "metric": "reputation_round_overhead",
+        "value": round(overhead, 3),
+        "unit": "x-vs-faulted-mean",
+        "reputation_updates_per_sec": round(rep_ups, 2),
         "faulted_mean_updates_per_sec": round(mean_ups, 2),
         "robust_agg": agg,
         "faults": faults,
@@ -606,6 +659,7 @@ def main():
                 # is four training runs — the headline must already be
                 # in the captured output before they start
                 print(json.dumps(headline))
+                headline_printed_early = True
             rec = bench_defended(ds, D, rounds, num_clients, platform)
             if rec:
                 print(json.dumps(rec))
@@ -613,6 +667,16 @@ def main():
             print("# defended leg skipped in CPU fallback (headline "
                   "first); set BENCH_DEFENDED=1 to keep it",
                   file=sys.stderr)
+        if not os.environ.get("BENCH_NO_REPUTATION"):
+            # the reputation leg ships on the fallback path too (its
+            # contract promises the metric on both paths), behind the
+            # same headline kill-safety duplicate
+            if not headline_printed_early:
+                print(json.dumps(headline))
+                headline_printed_early = True
+            rec = bench_reputation(ds, D, rounds, num_clients, platform)
+            if rec:
+                print(json.dumps(rec))
         if (os.environ.get("BENCH_SWEEP_BUCKETS")
                 or os.environ.get("BENCH_SWEEP_UNROLL")):
             print("# sweeps skipped in CPU fallback (headline first); "
@@ -658,15 +722,20 @@ def main():
     except Exception as e:  # pragma: no cover - defensive
         print(f"# FedAMW leg failed: {e!r}", file=sys.stderr)
 
-    # defended-round overhead (ISSUE 3): CPU-safe — tiny extra compile,
-    # same workload shapes, never raises past its own leg. Headline
-    # kill-safety first: the leg is four more training runs, and a
-    # driver-side wall-clock kill mid-leg must still leave the
-    # headline in the captured output (the BENCH_r02-null failure
-    # mode; the final re-print below stays THE parsed line)
-    if not os.environ.get("BENCH_NO_DEFENDED"):
+    # defended-round overhead (ISSUE 3) + reputation-round overhead
+    # (ISSUE 4): CPU-safe — tiny extra compile, same workload shapes,
+    # never raise past their own legs. Headline kill-safety first:
+    # each leg is four more training runs, and a driver-side
+    # wall-clock kill mid-leg must still leave the headline in the
+    # captured output (the BENCH_r02-null failure mode; the final
+    # re-print below stays THE parsed line)
+    if (not os.environ.get("BENCH_NO_DEFENDED")
+            or not os.environ.get("BENCH_NO_REPUTATION")):
         print(json.dumps(headline))
     rec = bench_defended(ds, D, rounds, num_clients, platform)
+    if rec:
+        print(json.dumps(rec))
+    rec = bench_reputation(ds, D, rounds, num_clients, platform)
     if rec:
         print(json.dumps(rec))
 

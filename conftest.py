@@ -15,7 +15,10 @@ import os
 # fixture parses one — must satisfy parse(canonical(parse(s))) ==
 # parse(s), or a new token could silently split the trainer jit cache
 # (canonical() is a cache-key component). Enabled here rather than in
-# each test so the whole suite sweeps the contract for free.
+# each test so the whole suite sweeps the contract for free. The
+# stateful tokens (rep:decay:floor, quarantine:auto) are the reason
+# this stays armed suite-wide: their canonical spellings embed float
+# repr()s, exactly the kind of formatting that drifts silently.
 os.environ.setdefault("FEDAMW_SPEC_ROUNDTRIP_CHECK", "1")
 
 if os.environ.get("FEDAMW_TEST_PLATFORM", "cpu") == "cpu":

@@ -87,25 +87,35 @@ def parse_args():
                     help="extension (jax): deterministic per-round fault "
                          "injection for FedAvg/FedProx/FedAMW — "
                          "'drop=0.1,straggle=0.2:0.5,corrupt=0.05:nan,"
-                         "seed=7' (fedcore.faults; rates per kind, "
-                         "straggle takes an update fraction, corrupt a "
-                         "mode nan|inf|sign|scale[:S]). The plan seed "
-                         "is offset per repeat; per-round fault/"
-                         "quarantine counts are reported after each "
-                         "algorithm")
+                         "lie=0.1:0.01,seed=7' (fedcore.faults; rates "
+                         "per kind, straggle takes an update fraction, "
+                         "corrupt a mode nan|inf|sign|scale[:S], lie a "
+                         "falsely REPORTED work fraction — the FedNova "
+                         "tau inflation attack the rep defense clamps). "
+                         "The plan seed is offset per repeat; per-round "
+                         "fault/quarantine counts are reported after "
+                         "each algorithm")
     ap.add_argument("--robust_agg", type=str, default="mean",
                     metavar="mean|median|trim:K|krum|mkrum:M|geomed[:T]"
-                            "|clip:R|quarantine:Z[+...]",
+                            "|clip:R|quarantine:Z|auto"
+                            "|rep[:decay[:floor]][+...]",
                     help="extension (jax): robust aggregation for the "
                          "round-based algorithms (fedcore.robust) — "
                          "non-finite reports are always quarantined "
                          "under faults; this adds norm clipping, "
                          "z-score quarantine of finite outliers "
-                         "(quarantine:Z), and/or a Byzantine-robust "
+                         "(quarantine:Z, or quarantine:auto to tune Z "
+                         "from the observed clean-round z "
+                         "distribution), cross-round per-client "
+                         "reputation (rep[:decay[:floor]]: directional "
+                         "+ norm evidence EWMA, soft down-weighting, "
+                         "hard gating below the floor, trust-bounded "
+                         "work fractions), and/or a Byzantine-robust "
                          "reduction (coordinate-wise trimmed-mean/"
                          "median, krum/multi-Krum, geometric median) "
                          "in place of the weighted average; defense "
-                         "telemetry is reported after each algorithm")
+                         "telemetry (incl. reputation trajectories) is "
+                         "reported after each algorithm")
     ap.add_argument("--feature_dtype", type=str, default=None,
                     choices=["bfloat16", "float16", "float32"],
                     help="extension (jax): store the mapped feature "

@@ -45,11 +45,23 @@ from ..fedcore import (
 )
 from ..fedcore.faults import inject_fault_row, resolve_fault_plan
 from ..fedcore.robust import (
+    Z_AUTO_BETA,
+    Z_AUTO_INIT,
+    Z_AUTO_MARGIN,
+    Z_AUTO_MAX,
+    Z_AUTO_MIN,
+    Z_AUTO_Q,
+    Z_EVIDENCE_REF,
+    _masked_vector_quantile,
+    client_delta_norms,
     clip_update_norms,
+    directional_scores,
     krum_select,
     make_robust_aggregator,
     parse_robust_spec,
+    reputation_update,
     sanitize_updates,
+    trust_bounded_work_frac,
     zscore_quarantine,
 )
 from ..ops.schedule import lr_schedule_array
@@ -170,6 +182,9 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
     # different plan reuses the same compiled program (zero recompiles).
     rspec = parse_robust_spec(robust_agg)
     robust_on = not rspec.is_default
+    rep_on = rspec.rep_decay is not None
+    zauto_on = rspec.zscore_auto
+    quarantine_active = rspec.zscore is not None or zauto_on
     # Krum-family selection on the LEARNED path folds into the present
     # mask BEFORE the p-solve — deselected clients carry exactly zero
     # learned mixture mass (like dropped/quarantined ones) and the
@@ -182,15 +197,43 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 if sel_m is not None else rspec)
     aggregate_robust = make_robust_aggregator(agg_spec)
 
+    def init_defense_state():
+        """The cross-round defense state riding the scan carry —
+        shape-stable (fixed (J,) / scalar leaves, keyed by STATIC
+        spec flags), so any fault plan reuses the compiled program.
+        Empty when the spec is memoryless (zero extra carry leaves —
+        the traced graph is unchanged)."""
+        st = {}
+        if rep_on:
+            # clients start fully trusted; honest equilibrium evidence
+            # is ~1.0, so reputation only moves on actual misbehavior
+            st["rep"] = jnp.ones(num_clients, jnp.float32)
+        if zauto_on:
+            # running clean-z quantile estimate (quarantine:auto)
+            st["zq"] = jnp.float32(Z_AUTO_INIT)
+        return st
+
     def guard_faults(params, stacked, losses, present, part_key_t,
-                     fault_row):
+                     fault_row, dstate):
         """Shared fault/participation/sanitize prologue of a 'fancy'
         round: starting from the valid-client mask in ``present``,
         returns the cleaned reports, the final present-client mask,
-        the round's non-finite quarantine count, and the scored-
-        quarantine telemetry (``quarantine:Z`` — the z-test runs on
-        UNCLIPPED delta norms over the post-sanitize present set and
-        folds into the same mask)."""
+        the round's non-finite quarantine count, the defense
+        telemetry, the updated cross-round defense state, and the
+        TRUSTED per-client work fraction (the reported one, clamped by
+        the reputation plane when active — what FedNova's tau and the
+        z-test normalization consume).
+
+        Order matters: (1) participation/drop/sanitize establish who
+        reported and who is finite; (2) the carried reputation's hard
+        gate (PREVIOUS rounds' verdicts) excludes distrusted clients
+        from this round's location/spread statistics; (3) the work
+        fraction is trust-clamped; (4) the z-test runs on
+        full-work-EQUIVALENT norms — scored over every finite reporter
+        (so gated clients keep earning evidence and can recover) with
+        stats over the trusted present set; (5) reputation updates by
+        EWMA and the NEW verdict gates the present mask the aggregate
+        and FedAMW's p-solve see."""
         if participation < 1.0:
             present = present * (
                 jax.random.uniform(part_key_t, present.shape)
@@ -206,19 +249,72 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
         present = present * ok
         quar_t = jnp.sum(reported * (1.0 - ok))
         aux = {}
-        if rspec.zscore is not None:
-            # under an active plan, score full-work-EQUIVALENT norms:
-            # the tau_frac row divides out each straggler's reported
-            # work fraction, so a majority-straggle round cannot shift
-            # the median down and quarantine the honest full-work
-            # clients (see zscore_quarantine's docstring)
+        new_state = dict(dstate)
+        work_frac = fault_row[4] if faults_on else None
+        rep_prev = dstate.get("rep")
+        # the finite reporters: the set reputation collects evidence
+        # over (a non-finite report earns exactly zero evidence)
+        scoreable = reported * ok
+        if rep_on:
+            # gate with the CARRIED reputation first so long-distrusted
+            # clients cannot pollute this round's median/MAD stats
+            present = present * jnp.where(
+                rep_prev >= rspec.rep_floor, 1.0, 0.0)
+        need_norms = quarantine_active or rep_on
+        norms = client_delta_norms(params, stacked) if need_norms else None
+        if rep_on and faults_on:
+            # trust-bound the self-reported work fraction BEFORE it
+            # normalizes the z-test or FedNova's tau (the frac=0.01
+            # inflation attack; fedcore.robust.trust_bounded_work_frac)
+            work_frac, n_clamped = trust_bounded_work_frac(
+                norms, work_frac, present, rep_prev)
+            aux["frac_clamped"] = n_clamped
+        z = None
+        z_ref = jnp.float32(Z_EVIDENCE_REF)
+        if need_norms:
+            if zauto_on:
+                # quarantine:auto — threshold from the carried
+                # clean-z quantile estimate (data, not program
+                # structure: changing it never recompiles)
+                z_ref = jnp.clip(Z_AUTO_MARGIN * dstate["zq"],
+                                 Z_AUTO_MIN, Z_AUTO_MAX)
+            elif rspec.zscore is not None:
+                z_ref = jnp.float32(rspec.zscore)
             zok, z = zscore_quarantine(
-                params, stacked, present, rspec.zscore,
-                work_frac=fault_row[4] if faults_on else None)
-            aux["z_quarantined"] = jnp.sum(present * (1.0 - zok))
-            aux["z_max"] = jnp.max(z)
-            present = present * zok
-        return stacked, losses, present, quar_t, aux
+                params, stacked, present, z_ref, work_frac=work_frac,
+                norms=norms, score_mask=scoreable if rep_on else None)
+            if quarantine_active:
+                aux["z_quarantined"] = jnp.sum(present * (1.0 - zok))
+                # restrict to the QUARANTINE decision set: under rep
+                # the score_mask is wider (gated clients keep being
+                # scored, against their RAW reported work fraction),
+                # and those scores would inflate the reported max z
+                # without describing any quarantine verdict
+                aux["z_max"] = jnp.max(z * present)
+                if zauto_on:
+                    aux["z_threshold"] = z_ref
+                    # fold this round's sub-threshold ("clean") scores
+                    # into the running quantile; an empty clean set
+                    # (degenerate round) leaves the estimate untouched
+                    clean = present * zok
+                    q_t = _masked_vector_quantile(z, clean, Z_AUTO_Q)
+                    q_t = jnp.where(jnp.sum(clean) > 0, q_t,
+                                    dstate["zq"])
+                    new_state["zq"] = ((1.0 - Z_AUTO_BETA) * dstate["zq"]
+                                       + Z_AUTO_BETA * q_t)
+                present = present * zok
+        if rep_on:
+            dir_cos = directional_scores(params, stacked, present)
+            rep_new = reputation_update(rep_prev, reported, scoreable,
+                                        dir_cos, present, z, z_ref,
+                                        rspec.rep_decay)
+            gate_new = jnp.where(rep_new >= rspec.rep_floor, 1.0, 0.0)
+            aux["rep_gated"] = jnp.sum(reported * (1.0 - gate_new))
+            aux["reputation"] = rep_new
+            new_state["rep"] = rep_new
+            present = present * gate_new
+        return (stacked, losses, present, quar_t, aux, new_state,
+                work_frac)
 
     def robust_round_aggregate(params, stacked, w_t, present):
         """Clip + robust reduction + the all-absent no-op gate. The
@@ -262,6 +358,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
             pkeys = jax.random.split(
                 jax.random.PRNGKey(seed + 1), rounds)[start_round:stop]
             p, opt_state = p0, init_opt(p0)
+            dstate0 = init_defense_state()
             if p_opt0 is not None:
                 # resume: the p-optimizer momentum buffer, shipped as a
                 # flat leaf tuple (checkpoint formats don't preserve
@@ -282,7 +379,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 xs.extend(fault_rows)
 
             def body(carry, inp):
-                params, p, opt_state = carry
+                params, p, opt_state, dstate = carry
                 if faults_on:
                     (t, lr_t, keys_t, pkey_t, part_key_t,
                      f_drop, f_scale, f_poison, f_fill, f_tau) = inp
@@ -298,10 +395,10 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                     params, X, y, idx, mask, keys_t, lr_t, mu, lam,
                 )
                 if fancy:
-                    stacked, losses, present, quar_t, dfaux = \
-                        guard_faults(params, stacked, losses,
-                                     client_valid, part_key_t,
-                                     fault_row)
+                    (stacked, losses, present, quar_t, dfaux, dstate,
+                     _eff_frac) = guard_faults(params, stacked, losses,
+                                               client_valid, part_key_t,
+                                               fault_row, dstate)
                     if sel_m is not None:
                         # krum/mkrum on the learned path: selection is
                         # a present-mask fold, so deselected clients
@@ -338,7 +435,11 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                     opt_state = jax.tree.map(
                         lambda new, old: jnp.where(any_p, new, old),
                         opt_s, opt_state)
-                    w_t = participation_weights(p_s, present)
+                    # reputation soft down-weighting: the learned mass
+                    # is additionally scaled by each survivor's trust
+                    # and renormalized (only RELATIVE trust shifts it)
+                    w_t = participation_weights(
+                        p_s, present, trust=dstate.get("rep"))
                     params, agg_aux = robust_round_aggregate(
                         params, stacked, w_t, present)
                     dfaux.update(agg_aux)
@@ -359,10 +460,10 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 if faults_on:
                     ys["quarantined"] = quar_t
                 ys.update(dfaux)
-                return (params, p, opt_state), ys
+                return (params, p, opt_state, dstate), ys
 
-            (params, p, opt_state), metrics = jax.lax.scan(
-                body, (params, p, opt_state), tuple(xs),
+            (params, p, opt_state, _dstate), metrics = jax.lax.scan(
+                body, (params, p, opt_state, dstate0), tuple(xs),
             )
             return metrics, params, p, opt_state
 
@@ -421,7 +522,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
             xs.extend(fault_rows)
 
         def body(carry, inp):
-            params, opt_state = carry
+            params, opt_state, dstate = carry
             if faults_on:
                 (t, lr_t, keys_t, part_key_t,
                  f_drop, f_scale, f_poison, f_fill, f_tau) = inp
@@ -440,21 +541,25 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 # both weight families renormalize over it and the
                 # (possibly order-statistic) aggregate is gated back to
                 # the old params when the round has nobody left
-                stacked, losses, present, quar_t, dfaux = guard_faults(
-                    params, stacked, losses, valid, part_key_t,
-                    fault_row)
+                (stacked, losses, present, quar_t, dfaux, dstate,
+                 eff_frac) = guard_faults(params, stacked, losses,
+                                          valid, part_key_t, fault_row,
+                                          dstate)
                 if aggregation == "nova" and faults_on:
                     # straggler-exact tau: the plan's per-round work
-                    # fraction rescales each client's local step count,
-                    # so normalized averaging reflects the work
-                    # ACTUALLY done, not the full-epoch assumption
-                    # (an all-ones row reproduces agg_w bitwise)
+                    # fraction — trust-clamped by the reputation plane
+                    # when active (the frac=0.01 inflation attack) —
+                    # rescales each client's local step count, so
+                    # normalized averaging reflects the work ACTUALLY
+                    # done, not the full-epoch assumption (an all-ones
+                    # row reproduces agg_w bitwise)
                     agg_w_t = fednova_effective_weights(
                         sizes, p_fixed, epoch, batch_size,
-                        tau_frac=fault_row[4])
+                        tau_frac=eff_frac)
                 else:
                     agg_w_t = agg_w
-                w_t = participation_weights(agg_w_t, present)
+                w_t = participation_weights(agg_w_t, present,
+                                            trust=dstate.get("rep"))
                 loss_w = participation_weights(p_fixed, present)
                 agg, agg_aux = robust_round_aggregate(
                     params, stacked, w_t, present)
@@ -496,7 +601,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
             if faults_on:
                 ys["quarantined"] = quar_t
             ys.update(dfaux)
-            return (params, opt_state), ys
+            return (params, opt_state, dstate), ys
 
         opt_state0 = (() if server_tx is None
                       else server_tx.init(params))
@@ -506,8 +611,8 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
             # tuple a checkpoint carries
             opt_state0 = jax.tree.unflatten(
                 jax.tree.structure(opt_state0), list(server_opt0))
-        (params, opt_state), metrics = jax.lax.scan(
-            body, (params, opt_state0), tuple(xs)
+        (params, opt_state, _dstate), metrics = jax.lax.scan(
+            body, (params, opt_state0, init_defense_state()), tuple(xs)
         )
         return metrics, params, p_fixed, opt_state
 
@@ -781,17 +886,25 @@ def _round_based(
     ``faults`` (None | spec string | FaultSpec | FaultPlan) injects
     deterministic client faults per round (``fedcore.faults``);
     ``robust_agg`` ("mean" | "median" | "trim:K" | "krum" | "mkrum:M"
-    | "geomed[:T]" | "clip:R" | "quarantine:Z" | "+" combinations,
-    ``fedcore.robust``) selects the defense. Both are static trainer
-    configuration; the plan's per-round rows are dynamic scanned
-    inputs, so changing the plan never recompiles. With faults active
-    the result carries ``fault_counts`` (per-round dropped / straggled
-    / corrupted / quarantined); an active defense additionally carries
-    ``defense`` (scored-quarantine counts and max z, krum selection
-    masks and pick counts, geomed Weiszfeld residuals). Under faults
-    FedNova's tau normalization is straggler-exact: the plan's
+    | "geomed[:T]" | "clip:R" | "quarantine:Z" | "quarantine:auto" |
+    "rep[:decay[:floor]]" | "+" combinations, ``fedcore.robust``)
+    selects the defense. Both are static trainer configuration; the
+    plan's per-round rows are dynamic scanned inputs, so changing the
+    plan never recompiles — the stateful tokens (``rep``,
+    ``quarantine:auto``) carry their cross-round state (per-client
+    reputation, the auto-threshold estimate) as shape-stable scan
+    carry leaves, so they too compile once. With faults active the
+    result carries ``fault_counts`` (per-round dropped / straggled /
+    corrupted / lied / quarantined); an active defense additionally
+    carries ``defense`` (scored-quarantine counts and max z, the
+    auto-tuned threshold trajectory, krum selection masks and pick
+    counts, geomed Weiszfeld residuals, per-client reputation
+    trajectories with gate and clamped-work-fraction counts). Under
+    faults FedNova's tau normalization is straggler-exact: the plan's
     per-round work fraction rescales each tau
-    (``fednova_effective_weights(tau_frac=...)``).
+    (``fednova_effective_weights(tau_frac=...)``), and with ``rep``
+    active the REPORTED fraction is first trust-clamped
+    (``fedcore.robust.trust_bounded_work_frac``).
 
     Every array is an explicit jit argument — a closure-captured device
     array would be baked into the HLO as a literal constant (hundreds of
@@ -960,6 +1073,7 @@ def _round_based(
             "dropped": (plan.drop[sl] * valid_np).sum(1).astype(int),
             "straggled": (plan.straggle[sl] * valid_np).sum(1).astype(int),
             "corrupted": (plan.corrupt[sl] * valid_np).sum(1).astype(int),
+            "lied": (plan.lie[sl] * valid_np).sum(1).astype(int),
             "quarantined": np.rint(metrics["quarantined"]).astype(int),
         }
     # defense telemetry (utils.reporting.format_defense_report): the
@@ -970,6 +1084,17 @@ def _round_based(
         defense["z_quarantined"] = np.rint(
             metrics["z_quarantined"]).astype(int)
         defense["z_max"] = metrics["z_max"]
+    if "z_threshold" in metrics:
+        # quarantine:auto — the per-round auto-tuned threshold
+        defense["z_threshold"] = metrics["z_threshold"]
+    if "reputation" in metrics:
+        # per-client reputation trajectories (rounds, J) + the hard
+        # gate and clamped-work-fraction counts the rep token emits
+        defense["reputation"] = metrics["reputation"]
+        defense["rep_gated"] = np.rint(metrics["rep_gated"]).astype(int)
+    if "frac_clamped" in metrics:
+        defense["frac_clamped"] = np.rint(
+            metrics["frac_clamped"]).astype(int)
     if "krum_selected" in metrics:
         sel = np.rint(metrics["krum_selected"]).astype(int)
         defense["krum_selected"] = sel

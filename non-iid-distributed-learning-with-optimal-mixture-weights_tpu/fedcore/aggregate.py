@@ -71,7 +71,8 @@ def fednova_effective_weights(
     return jnp.where(tau > 0, p * tau_eff / safe_tau, 0.0)
 
 
-def participation_weights(agg_w: jax.Array, part: jax.Array) -> jax.Array:
+def participation_weights(agg_w: jax.Array, part: jax.Array,
+                          trust: jax.Array | None = None) -> jax.Array:
     """Aggregation weights restricted to a participation mask.
 
     Partial client participation (an extension — the reference always
@@ -82,8 +83,18 @@ def participation_weights(agg_w: jax.Array, part: jax.Array) -> jax.Array:
     renormalization; for FedNova it preserves the tau-scaled total.
     An all-absent round returns all-zero weights (callers keep the old
     global params in that case).
+
+    ``trust`` (a per-client ``[0, 1]`` vector — the reputation plane's
+    soft down-weighting, ``fedcore.robust``) additionally scales each
+    survivor's weight before the renormalization, so only RELATIVE
+    trust shifts mass: a uniformly-trusted cohort is bitwise unchanged
+    in intent (the scale factor cancels), while a low-trust client's
+    mass moves to its trusted peers. ``None`` keeps the exact
+    pre-reputation weights.
     """
     masked = agg_w * part
+    if trust is not None:
+        masked = masked * trust
     total = jnp.sum(masked)
     scale = jnp.where(total > 0, jnp.sum(agg_w) / jnp.maximum(total, 1e-30),
                       0.0)

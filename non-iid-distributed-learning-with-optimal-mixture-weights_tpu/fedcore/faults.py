@@ -36,11 +36,16 @@ from one uniform draw):
   ``fedcore.robust``), ``sign`` (update negated), or ``scale`` (update
   multiplied by ``corrupt_scale``; the finite modes are what norm
   clipping and the trimmed-mean/median aggregators defend against).
+- **lying**: the update is HONEST (full local work, bitwise untouched)
+  but the client's self-REPORTED work fraction is ``lie_frac`` instead
+  of 1 — the FedNova tau inflation attack (a claim of ``frac=0.01``
+  earns a ~100x per-step effective weight) that the reputation plane's
+  :func:`fedcore.robust.trust_bounded_work_frac` exists to close.
 
 Spec string syntax (the ``exp.py --faults`` surface)::
 
-    drop=0.1,straggle=0.2:0.5,corrupt=0.05:nan,seed=7
-         ^rate          ^rate ^frac        ^mode[:scale]
+    drop=0.1,straggle=0.2:0.5,corrupt=0.05:nan,lie=0.1:0.01,seed=7
+         ^rate          ^rate ^frac        ^mode[:scale] ^rate ^claim
 
 Clean clients pass through **bitwise untouched** (the injection is a
 ``where`` on the faulty cells only), so a faulty run's surviving
@@ -70,24 +75,31 @@ class FaultSpec:
     corrupt: float = 0.0
     corrupt_mode: str = "nan"
     corrupt_scale: float = 10.0
+    lie: float = 0.0
+    lie_frac: float = 0.01
     seed: int = 0
 
     def __post_init__(self):
-        for name in ("drop", "straggle", "corrupt"):
+        for name in ("drop", "straggle", "corrupt", "lie"):
             r = getattr(self, name)
             if not 0.0 <= r <= 1.0:
                 raise ValueError(
                     f"fault rate {name}={r} must be in [0, 1]")
-        total = self.drop + self.straggle + self.corrupt
+        total = self.drop + self.straggle + self.corrupt + self.lie
         if total > 1.0:
             raise ValueError(
                 f"fault rates must sum to <= 1 (a client is at most one "
-                f"of dropped/straggling/corrupted per round), got "
-                f"drop+straggle+corrupt={total}")
+                f"of dropped/straggling/corrupted/lying per round), got "
+                f"drop+straggle+corrupt+lie={total}")
         if not 0.0 < self.straggle_frac <= 1.0:
             raise ValueError(
                 f"straggle_frac={self.straggle_frac} must be in (0, 1] "
                 "(the fraction of the local update that survives)")
+        if not 0.0 < self.lie_frac <= 1.0:
+            raise ValueError(
+                f"lie_frac={self.lie_frac} must be in (0, 1] (the work "
+                "fraction the lying client CLAIMS; its actual work is "
+                "always full)")
         if self.corrupt_mode not in _CORRUPT_MODES:
             raise ValueError(
                 f"corrupt_mode={self.corrupt_mode!r}; expected one of "
@@ -113,13 +125,13 @@ class FaultSpec:
                     "(expected e.g. 'drop=0.1,corrupt=0.05:nan,seed=7')")
             key, val = token.split("=", 1)
             key = key.strip().lower()
-            if key not in ("drop", "straggle", "corrupt", "seed"):
+            if key not in ("drop", "straggle", "corrupt", "lie", "seed"):
                 # raised OUTSIDE the conversion guard below: routing
                 # it by exception-text matching would misfire on user
                 # values that happen to contain the same words
                 raise ValueError(
                     f"unknown fault spec key {key!r} (expected "
-                    "drop/straggle/corrupt/seed)")
+                    "drop/straggle/corrupt/lie/seed)")
             try:
                 if key == "drop":
                     kw["drop"] = float(val)
@@ -128,6 +140,11 @@ class FaultSpec:
                     kw["straggle"] = float(rate)
                     if frac:
                         kw["straggle_frac"] = float(frac)
+                elif key == "lie":
+                    rate, _, frac = val.partition(":")
+                    kw["lie"] = float(rate)
+                    if frac:
+                        kw["lie_frac"] = float(frac)
                 elif key == "corrupt":
                     rate, _, rest = val.partition(":")
                     kw["corrupt"] = float(rate)
@@ -148,14 +165,20 @@ class FaultPlan:
     """Dense per-``(round, client)`` fault schedule.
 
     All arrays are host-side ``(rounds, num_clients)`` float32:
-    ``drop``/``straggle``/``corrupt`` are 0/1 role masks (mutually
-    exclusive), ``scale`` the delta multiplier (1 for clean cells),
-    ``poison`` the 0/1 full-poison mask and ``fill`` its NaN/Inf value
-    (0 elsewhere). Construction is deterministic in the spec: the same
-    ``FaultSpec`` always builds the identical plan.
+    ``drop``/``straggle``/``corrupt``/``lie`` are 0/1 role masks
+    (mutually exclusive), ``scale`` the delta multiplier (1 for clean
+    cells), ``poison`` the 0/1 full-poison mask and ``fill`` its
+    NaN/Inf value (0 elsewhere). ``report`` is the work fraction each
+    client REPORTS for the round — derived from the straggle cells
+    (``straggle_frac`` there, 1 elsewhere) when not given, overridden
+    to ``lie_frac`` on lying cells (whose actual update is untouched:
+    the lie is in the report, not the work). Construction is
+    deterministic in the spec: the same ``FaultSpec`` always builds
+    the identical plan.
     """
 
-    def __init__(self, drop, straggle, corrupt, scale, poison, fill):
+    def __init__(self, drop, straggle, corrupt, scale, poison, fill,
+                 report=None, lie=None):
         arrs = [np.asarray(a, np.float32)
                 for a in (drop, straggle, corrupt, scale, poison, fill)]
         shape = arrs[0].shape
@@ -166,6 +189,33 @@ class FaultPlan:
         self.drop, self.straggle, self.corrupt = arrs[:3]
         self.scale, self.poison, self.fill = arrs[3:]
         self.rounds, self.num_clients = shape
+        for name, a in (("report", report), ("lie", lie)):
+            if a is not None and np.asarray(a).shape != shape:
+                raise ValueError(
+                    f"FaultPlan {name} must match the "
+                    f"(rounds, num_clients) shape {shape}, got "
+                    f"{np.asarray(a).shape}")
+        self.lie = (np.zeros(shape, np.float32) if lie is None
+                    else np.asarray(lie, np.float32))
+        if report is None:
+            if self.lie.any():
+                # a lie mask without the claimed fractions would
+                # silently build a CLEAN plan (derived report = 1.0 on
+                # lying cells) while fault_counts still labeled those
+                # cells "lied" — the experiment would believe it
+                # tested the attack it never injected
+                raise ValueError(
+                    "FaultPlan with a nonzero lie mask needs an "
+                    "explicit report array carrying the claimed work "
+                    "fractions (FaultPlan.build derives it from "
+                    "lie_frac)")
+            # the derived honest report: straggling cells report the
+            # work they actually completed, everyone else full work (a
+            # corrupt cell's scale is an adversarial multiplier, not
+            # work done)
+            report = np.where(self.straggle > 0, self.scale,
+                              np.float32(1.0))
+        self.report = np.asarray(report, np.float32)
 
     @classmethod
     def build(cls, spec: FaultSpec, rounds: int,
@@ -179,6 +229,9 @@ class FaultPlan:
         straggle = ~drop & (u < spec.drop + spec.straggle)
         corrupt = (~drop & ~straggle
                    & (u < spec.drop + spec.straggle + spec.corrupt))
+        lie = (~drop & ~straggle & ~corrupt
+               & (u < spec.drop + spec.straggle + spec.corrupt
+                  + spec.lie))
         scale = np.ones((rounds, num_clients), np.float32)
         scale[straggle] = spec.straggle_frac
         poison = np.zeros_like(scale)
@@ -191,27 +244,33 @@ class FaultPlan:
             poison[corrupt] = 1.0
             fill[corrupt] = (np.nan if spec.corrupt_mode == "nan"
                              else np.inf)
-        return cls(drop, straggle, corrupt, scale, poison, fill)
+        # a lying cell's WORK is honest (scale stays 1); only its
+        # reported fraction is false
+        report = np.where(straggle, np.float32(spec.straggle_frac),
+                          np.float32(1.0))
+        report[lie] = spec.lie_frac
+        return cls(drop, straggle, corrupt, scale, poison, fill,
+                   report=report, lie=lie)
 
     def rows(self, start: int, stop: int):
         """The in-graph slice: ``(drop, scale, poison, fill,
         tau_frac)`` device arrays for rounds ``[start, stop)``, shaped
         to ride the round scan as ordinary per-round inputs (the role
-        masks ``straggle``/``corrupt`` stay host-side for reporting).
-        ``tau_frac`` is the fraction of the local work each client
-        actually completed — ``straggle_frac`` on straggling cells, 1
-        elsewhere (a corrupt cell's scale is an adversarial multiplier,
-        not work done) — which is what makes FedNova's tau
-        normalization straggler-exact
-        (``aggregate.fednova_effective_weights``). Sliced from the full
-        horizon exactly like the LR schedule, so prefix + resume
+        masks ``straggle``/``corrupt``/``lie`` stay host-side for
+        reporting). ``tau_frac`` is the work fraction each client
+        REPORTS — ``straggle_frac`` on straggling cells, ``lie_frac``
+        on lying cells, 1 elsewhere (a corrupt cell's scale is an
+        adversarial multiplier, not work done) — which is what makes
+        FedNova's tau normalization straggler-exact
+        (``aggregate.fednova_effective_weights``) and what the
+        reputation plane's trust bound clamps for liars
+        (``fedcore.robust.trust_bounded_work_frac``). Sliced from the
+        full horizon exactly like the LR schedule, so prefix + resume
         replays the identical faults."""
         sl = slice(start, stop)
-        tau_frac = np.where(self.straggle > 0, self.scale,
-                            np.float32(1.0)).astype(np.float32)
         return tuple(jnp.asarray(a[sl]) for a in
                      (self.drop, self.scale, self.poison, self.fill,
-                      tau_frac))
+                      self.report))
 
 
 def resolve_fault_plan(faults, rounds: int, num_clients: int):

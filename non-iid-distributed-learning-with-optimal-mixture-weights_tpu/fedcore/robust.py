@@ -50,13 +50,58 @@ to ``+inf``, and the dynamic present-count enters only through
 ``where``-gated index/threshold arithmetic — no data-dependent shapes,
 so the round trainer compiles once.
 
+Cross-round state (the stateful reputation plane, ISSUE 4): the
+per-round detectors above are memoryless — a sign-flipping client that
+survives one round's z-test is fully trusted again next round. The
+``rep[:decay[:floor]]`` token adds a per-client reputation vector
+``r in [0,1]^J`` carried across rounds in the trainer's scan carry
+(``algorithms.core``), updated each round by an EWMA over two evidence
+channels:
+
+- the existing robust z-score on work-normalized delta norms
+  (:func:`zscore_quarantine`'s ``z``, squashed by
+  ``exp(-max(z - Z, 0))`` so sub-threshold clients earn full
+  evidence), and
+- a new **directional** score (:func:`directional_scores`): the cosine
+  of each client's delta to the coordinate-wise median delta — the
+  ``O(JP)`` detector for norm-preserving sign flips that are invisible
+  to ANY norm test, without paying krum's ``O(J^2 P)``.
+
+Reputation folds into aggregation three ways: survivor weights are
+softly scaled by ``r`` (``aggregate.participation_weights(trust=)``,
+renormalized so only RELATIVE trust matters), clients below ``floor``
+are hard-gated out of the same 0/1 present mask the quarantines feed
+(so FedAMW's masked solve assigns them exactly zero learned mass with
+no new code path), and the self-REPORTED work fraction is clamped by
+:func:`trust_bounded_work_frac` before it touches the z-test
+normalization or FedNova's tau — closing the self-reported-work attack
+(a client claiming ``frac=0.01`` while doing full-norm work inflates
+its FedNova per-step weight ~100x; the claim is cross-checked against
+its observed delta norm and pulled toward the cohort median as its
+reputation drops). Evidence is collected over every REPORTING client —
+including currently-gated ones — so a transiently-corrupted honest
+client recovers within ``O(1/(1-decay))`` rounds, while a persistent
+attacker's reputation converges geometrically to the floor and stays
+gated (FLTrust, Cao et al. 2021, arXiv:2012.13995, is the
+trust-score precedent).
+
+``quarantine:auto`` replaces the hand-picked ``Z`` with a threshold
+estimated from the observed clean-round z distribution: a running
+quantile of the sub-threshold scores (EWMA, carried in the same scan
+state, static shapes) scaled by :data:`Z_AUTO_MARGIN` and clipped to
+``[Z_AUTO_MIN, Z_AUTO_MAX]``. It starts at the hand-tuned ``Z=5``
+operating point (README) and adapts toward the cohort's own spread.
+
 ``robust_agg`` spec syntax (the ``exp.py --robust_agg`` surface):
 ``"mean"`` (default, today's exact graph), ``"median"``, ``"trim:K"``,
 ``"krum"``, ``"mkrum:M"``, ``"geomed[:T]"`` (T Weiszfeld iterations,
 default 8), ``"clip:R"`` (clip + mean), ``"quarantine:Z"`` (z-score
-quarantine + mean), or ``+``-joined combinations like
-``"clip:5+trim:1"`` or ``"quarantine:3+mkrum:6"`` (detection first,
-then clip, then the robust reduction).
+quarantine + mean), ``"quarantine:auto"`` (auto-tuned threshold),
+``"rep[:decay[:floor]]"`` (cross-round reputation, default
+``rep:0.9:0.2``), or ``+``-joined combinations like
+``"clip:5+trim:1"``, ``"quarantine:3+mkrum:6"`` or
+``"rep:0.9+quarantine:auto"`` (detection first, then clip, then the
+robust reduction).
 """
 
 from __future__ import annotations
@@ -74,6 +119,45 @@ from .aggregate import weighted_average
 # sets the unrolled loop length inside the jitted round scan)
 GEOMED_ITERS_DEFAULT = 8
 
+# -- reputation (rep token) defaults ----------------------------------
+# EWMA decay: equilibrium memory ~1/(1-decay) rounds (0.9 -> ~10)
+REP_DECAY_DEFAULT = 0.9
+# hard-gate floor: a client whose reputation falls below it is folded
+# out of the present mask (0.0 = soft down-weighting only). Honest
+# equilibrium evidence is ~1.0 (full evidence every round), so any
+# floor well below 1 is safe for honest clients.
+REP_FLOOR_DEFAULT = 0.2
+# z evidence reference when the spec carries `rep` without a
+# quarantine token: scores below it earn full evidence (the classical
+# Z=3 ballpark — only beyond-threshold z erodes reputation)
+Z_EVIDENCE_REF = 3.0
+# directional-evidence reference: the cosine channel is standardized
+# against the cohort's own median/MAD (like the norm z-test — an
+# absolute cosine scale would mis-punish honest non-IID heterogeneity,
+# where within-cohort cosines to the median delta are only mildly
+# positive), and only a LOWER-tail deviation beyond this many robust
+# sigmas erodes evidence (measured on Dirichlet-0.5 digits: honest
+# clients stay below ~1.5, a sign-flipped client lands at ~3-4)
+DIR_Z_REF = 2.0
+# norm-implied work-fraction slack: a reported fraction is only bumped
+# up when the observed delta norm implies MORE than FRAC_MARGIN x the
+# claimed work (honest norm scatter must not clamp honest claims)
+FRAC_MARGIN = 2.0
+
+# -- quarantine:auto threshold estimator ------------------------------
+# threshold = clip(Z_AUTO_MARGIN * m, Z_AUTO_MIN, Z_AUTO_MAX) where m
+# is a running (EWMA, rate Z_AUTO_BETA) Z_AUTO_Q-quantile of the
+# OBSERVED sub-threshold ("clean") z scores, carried in the scan
+# state. m starts at Z_AUTO_INIT, placing the initial threshold at the
+# hand-tuned Z=5 operating point (README: honest digits clients top
+# out near z ~ 3.3, a 25x attacker lands at z > 50).
+Z_AUTO_INIT = 10.0 / 3.0
+Z_AUTO_MARGIN = 1.5
+Z_AUTO_MIN = 3.0
+Z_AUTO_MAX = 20.0
+Z_AUTO_BETA = 0.1
+Z_AUTO_Q = 1.0  # the running max of the clean z distribution
+
 # set (by conftest) to make every parse_robust_spec call verify the
 # canonical round-trip contract: parse(canonical(parse(s))) == parse(s)
 # for the accepted spelling s — a new token whose canonical spelling
@@ -85,7 +169,8 @@ SPEC_ROUNDTRIP_ENV = "FEDAMW_SPEC_ROUNDTRIP_CHECK"
 @dataclasses.dataclass(frozen=True)
 class RobustSpec:
     """Parsed ``robust_agg`` spec: aggregator choice + optional
-    norm clip + optional z-score quarantine threshold."""
+    norm clip + optional z-score quarantine threshold (fixed or
+    auto-tuned) + optional cross-round reputation."""
 
     agg: str = "mean"           # mean | median | trim | krum | mkrum | geomed
     trim: int = 0               # k, for agg == "trim"
@@ -93,6 +178,9 @@ class RobustSpec:
     geomed_iters: int = 0       # Weiszfeld iterations, for agg == "geomed"
     clip: float | None = None   # max delta L2 norm, or None
     zscore: float | None = None  # quarantine z threshold, or None
+    zscore_auto: bool = False   # quarantine:auto (threshold from state)
+    rep_decay: float | None = None  # reputation EWMA decay, or None (off)
+    rep_floor: float = 0.0      # hard-gate floor, for rep_decay set
 
     def canonical(self) -> str:
         """One spelling per spec — used as a trainer cache-key
@@ -102,8 +190,12 @@ class RobustSpec:
         parts = []
         if self.clip is not None:
             parts.append(f"clip:{self.clip}")
-        if self.zscore is not None:
+        if self.zscore_auto:
+            parts.append("quarantine:auto")
+        elif self.zscore is not None:
             parts.append(f"quarantine:{self.zscore}")
+        if self.rep_decay is not None:
+            parts.append(f"rep:{self.rep_decay}:{self.rep_floor}")
         if self.agg == "trim":
             parts.append(f"trim:{self.trim}")
         elif self.agg == "mkrum":
@@ -117,7 +209,14 @@ class RobustSpec:
     @property
     def is_default(self) -> bool:
         return (self.agg == "mean" and self.clip is None
-                and self.zscore is None)
+                and self.zscore is None and not self.zscore_auto
+                and self.rep_decay is None)
+
+    @property
+    def stateful(self) -> bool:
+        """True when the spec needs cross-round scan state (the
+        reputation vector and/or the auto-threshold estimate)."""
+        return self.zscore_auto or self.rep_decay is not None
 
     @property
     def select_m(self) -> int | None:
@@ -179,11 +278,45 @@ def parse_robust_spec(spec) -> RobustSpec:
     return out
 
 
+def _parse_rep_token(spec, token):
+    """``rep[:decay[:floor]]`` -> (decay, floor), validated."""
+    import math
+
+    fields = token.split(":")
+    if len(fields) > 3:
+        raise ValueError(
+            f"robust_agg={spec!r}: rep takes at most decay and floor "
+            f"('rep[:decay[:floor]]'), got {token!r}")
+    # parse the two fields independently so the error names the one
+    # that is actually malformed ('rep:0.9:abc' is a floor problem,
+    # not a decay problem)
+    try:
+        decay = float(fields[1]) if len(fields) > 1 else REP_DECAY_DEFAULT
+    except ValueError:
+        decay = math.nan
+    try:
+        floor = float(fields[2]) if len(fields) > 2 else REP_FLOOR_DEFAULT
+    except ValueError:
+        floor = math.nan
+    # strict decay bounds: 1 would freeze reputation forever, 0 keeps
+    # no memory at all (use the memoryless detectors for that)
+    if not (0.0 < decay < 1.0):
+        raise ValueError(
+            f"robust_agg={spec!r}: the rep decay must be in (0, 1), "
+            f"got {token!r}")
+    if not (0.0 <= floor < 1.0):
+        raise ValueError(
+            f"robust_agg={spec!r}: the rep floor must be in [0, 1), "
+            f"got {token!r}")
+    return decay, floor
+
+
 def _parse_robust_spec(spec) -> RobustSpec:
     if isinstance(spec, RobustSpec):
         return spec
     agg, trim, mkrum_m, geomed_iters = "mean", 0, 0, 0
-    clip = zscore = None
+    clip = zscore = rep_decay = None
+    zscore_auto, rep_floor = False, 0.0
     agg_set = False
     for token in str(spec).split("+"):
         token = token.strip().lower()
@@ -218,21 +351,31 @@ def _parse_robust_spec(spec) -> RobustSpec:
                     "per spec")
             clip = _parse_pos_float(spec, token, "the clip radius", 1.0)
         elif head == "quarantine":
-            if zscore is not None:
+            if zscore is not None or zscore_auto:
                 raise ValueError(
                     f"robust_agg={spec!r}: at most one quarantine "
                     "threshold per spec")
-            zscore = _parse_pos_float(
-                spec, token, "the quarantine z threshold", 3.0)
+            if token.partition(":")[2].strip() == "auto":
+                zscore_auto = True
+            else:
+                zscore = _parse_pos_float(
+                    spec, token, "the quarantine z threshold", 3.0)
+        elif head == "rep":
+            if rep_decay is not None:
+                raise ValueError(
+                    f"robust_agg={spec!r}: at most one rep token "
+                    "per spec")
+            rep_decay, rep_floor = _parse_rep_token(spec, token)
         else:
             raise ValueError(
                 f"robust_agg={spec!r}: unknown token {token!r} "
                 "(expected mean, median, trim:K, krum, mkrum:M, "
-                "geomed[:T], clip:R, quarantine:Z, or '+'-joined "
-                "combinations)")
+                "geomed[:T], clip:R, quarantine:Z|auto, "
+                "rep[:decay[:floor]], or '+'-joined combinations)")
     return RobustSpec(agg=agg, trim=trim, mkrum_m=mkrum_m,
                       geomed_iters=geomed_iters, clip=clip,
-                      zscore=zscore)
+                      zscore=zscore, zscore_auto=zscore_auto,
+                      rep_decay=rep_decay, rep_floor=rep_floor)
 
 
 def _bcast(v, ndim: int):
@@ -291,8 +434,26 @@ def _masked_vector_median(v: jax.Array, present: jax.Array) -> jax.Array:
     return 0.5 * (s[lo] + s[hi])
 
 
-def zscore_quarantine(params, stacked, present: jax.Array, z_max: float,
-                      work_frac: jax.Array | None = None):
+def _masked_vector_quantile(v: jax.Array, present: jax.Array,
+                            q: float) -> jax.Array:
+    """Empirical ``q``-quantile of a ``(J,)`` vector over the present
+    entries (``q=1`` is the masked max; ``q=0.5`` the upper median).
+    Absent entries sort to ``-inf`` so the present ones occupy the TOP
+    of the ascending sort; the rank index is traced present-count
+    arithmetic — shape-stable like the median above. With zero present
+    entries the result is ``-inf``; callers gate on the count."""
+    J = v.shape[0]
+    n = jnp.sum(present).astype(jnp.int32)
+    k = jnp.clip(jnp.ceil(q * n).astype(jnp.int32), 1, jnp.maximum(n, 1))
+    idx = jnp.clip(J - n + k - 1, 0, J - 1)
+    s = jnp.sort(jnp.where(present > 0, v, -jnp.inf))
+    return s[idx]
+
+
+def zscore_quarantine(params, stacked, present: jax.Array, z_max,
+                      work_frac: jax.Array | None = None,
+                      norms: jax.Array | None = None,
+                      score_mask: jax.Array | None = None):
     """Score finite clients by a robust delta-norm z-test (traced).
 
     The score is the UPPER-TAIL MAD-standardized z
@@ -337,9 +498,20 @@ def zscore_quarantine(params, stacked, present: jax.Array, z_max: float,
     (numerically identical updates) scores everyone 0 rather than
     amplifying float noise into quarantines. Norm-preserving attacks
     (a pure sign flip) are invisible to ANY norm test — pair with a
-    distance-based aggregator (krum/mkrum/geomed) for those.
+    distance-based aggregator (krum/mkrum/geomed) or the cross-round
+    ``rep`` token (directional evidence) for those.
+
+    ``norms`` lets a caller that already computed the raw delta norms
+    share them (the reputation plane needs them for the work-fraction
+    cross-check too); ``score_mask`` widens the set of SCORED clients
+    beyond ``present`` (reputation scores currently-gated clients
+    against the trusted cohort's stats so they can recover) — the
+    location/spread stats always come from ``present`` alone, and
+    ``z_max`` may be a traced scalar (the ``quarantine:auto``
+    threshold rides the scan state).
     """
-    norms = client_delta_norms(params, stacked)
+    if norms is None:
+        norms = client_delta_norms(params, stacked)
     if work_frac is not None:
         norms = norms / jnp.clip(work_frac, 1e-6, 1.0)
     med = _masked_vector_median(norms, present)
@@ -347,10 +519,122 @@ def zscore_quarantine(params, stacked, present: jax.Array, z_max: float,
     mad = _masked_vector_median(dev, present)
     spread = 1.4826 * mad  # MAD -> std of a normal, the standard scale
     floor = 1e-6 * med + 1e-30
-    z = (present * jnp.maximum(norms - med, 0.0)
+    scored = present if score_mask is None else score_mask
+    z = (scored * jnp.maximum(norms - med, 0.0)
          / jnp.maximum(spread, floor))
     ok = jnp.where(z <= z_max, 1.0, 0.0)
     return ok, z
+
+
+def directional_scores(params, stacked, present: jax.Array) -> jax.Array:
+    """Cosine of each client's update delta to the coordinate-wise
+    median delta over the present clients: ``(J,)``.
+
+    The ``O(JP)`` directional detector (vs krum's ``O(J^2 P)``
+    pairwise distances): a norm-preserving sign flip — invisible to
+    any norm test — lands at cosine ~ -1 against the honest
+    consensus direction, while honest non-IID heterogeneity stays at
+    positive-to-mildly-positive cosine. The median (not mean) makes
+    the consensus direction itself robust to a corrupted minority.
+    Degenerate cases (zero present clients, all-zero median) return
+    non-finite or zero cosines; consumers sanitize
+    (:func:`reputation_update` maps non-finite to zero evidence).
+    """
+    x = _flat_deltas(params, stacked)
+    med = coordinatewise_median({"x": x}, present)["x"]
+    dot = x @ med
+    nx = jnp.sqrt(jnp.sum(jnp.square(x), axis=1))
+    nm = jnp.sqrt(jnp.sum(jnp.square(med)))
+    return dot / jnp.maximum(nx * nm, 1e-30)
+
+
+def trust_bounded_work_frac(norms: jax.Array, reported_frac: jax.Array,
+                            present: jax.Array, rep: jax.Array):
+    """Clamp the self-REPORTED work fraction by reputation and by the
+    observed delta norms (traced).
+
+    FedNova's premise is that clients report their own local work, and
+    both consumers of the report are gameable: the z-test normalizes
+    norms by it, and ``fednova_effective_weights(tau_frac=)`` assigns
+    a client claiming ``frac=0.01`` a ~100x per-step weight. Two
+    bounds close the attack without punishing honest stragglers:
+
+    - **reputation band**: the claim is pulled toward the cohort
+      median claim as reputation drops —
+      ``trusted = med + rep * (claim - med)``. A fully-trusted client
+      (``rep=1``) keeps its claim exactly; a zero-reputation client's
+      claim is replaced by the cohort median wholesale.
+    - **norm cross-check**: the observed delta norm implies a lower
+      bound on the work actually done. With ``eq = norm / claim`` the
+      cohort-median full-work-equivalent norm is robust to a lying
+      minority (the liar's eq is an upper outlier), and a claim is
+      bumped up to ``norm / (FRAC_MARGIN * median(eq))`` when the
+      observed norm implies more than ``FRAC_MARGIN``x the claimed
+      work. An honest straggler's norm is proportional to its claim,
+      so its implied bound sits ``FRAC_MARGIN``x BELOW its claim —
+      never clamped.
+
+    Returns ``(trusted, n_clamped)``: the clamped per-client fraction
+    (reported passes through unchanged on absent clients) and the
+    count of present clients whose claim moved by more than 1e-3 (the
+    ``frac_clamped`` telemetry).
+    """
+    med_frac = _masked_vector_median(reported_frac, present)
+    trusted = med_frac + rep * (reported_frac - med_frac)
+    eq = norms / jnp.clip(reported_frac, 1e-6, 1.0)
+    med_eq = _masked_vector_median(eq, present)
+    implied = norms / jnp.maximum(FRAC_MARGIN * med_eq, 1e-30)
+    trusted = jnp.maximum(trusted, jnp.minimum(implied, 1.0))
+    trusted = jnp.clip(trusted, 1e-6, 1.0)
+    trusted = jnp.where(present > 0, trusted, reported_frac)
+    n_clamped = jnp.sum(
+        present * (jnp.abs(trusted - reported_frac) > 1e-3))
+    return trusted, n_clamped
+
+
+def reputation_update(rep: jax.Array, reported: jax.Array,
+                      scoreable: jax.Array, dir_cos: jax.Array,
+                      present: jax.Array, z: jax.Array | None, z_ref,
+                      decay: float):
+    """One EWMA reputation step over the two evidence channels
+    (traced): ``rep' = decay * rep + (1 - decay) * evidence`` on every
+    REPORTING client, unchanged elsewhere (an absent client's
+    reputation neither decays nor recovers — no evidence either way).
+
+    Evidence is the product of two ``[0, 1]`` channels, masked by
+    ``scoreable`` (a client that reported non-finite garbage earns
+    exactly zero evidence that round):
+
+    - **directional**: the cosine to the median delta, standardized
+      against the PRESENT cohort's own median/MAD (an absolute cosine
+      scale would punish honest non-IID heterogeneity, where
+      within-cohort cosines are only mildly positive). Only the lower
+      tail erodes evidence — ``exp(-max(dz - DIR_Z_REF, 0))`` with
+      ``dz = max(med_cos - cos, 0) / (1.4826 * MAD)`` — so an honest
+      outlier shard keeps full evidence while a sign flip, several
+      robust sigmas below the cohort, decays geometrically.
+    - **norm**: ``exp(-max(z - z_ref, 0))`` over the work-normalized
+      delta-norm z — full evidence below the (possibly auto-tuned)
+      threshold, geometric decay beyond it.
+
+    Honest equilibrium is therefore evidence ~ 1.0 -> rep ~ 1.0; a
+    persistent attacker's rep decays geometrically toward 0; a
+    recovered client climbs back within ``O(1/(1-decay))`` rounds.
+    Non-finite cosines (degenerate empty rounds) are treated as
+    maximally deviant, and non-finite evidence (empty-cohort stats)
+    becomes zero rather than poisoning the carried state.
+    """
+    cos = jnp.where(jnp.isfinite(dir_cos), dir_cos, -1.0)
+    med = _masked_vector_median(cos, present)
+    mad = _masked_vector_median(jnp.abs(cos - med), present)
+    spread = jnp.maximum(1.4826 * mad, 1e-6)
+    dz = jnp.maximum(med - cos, 0.0) / spread
+    d_ev = jnp.exp(-jnp.maximum(dz - DIR_Z_REF, 0.0))
+    z_ev = (jnp.exp(-jnp.maximum(z - z_ref, 0.0)) if z is not None
+            else jnp.ones_like(rep))
+    ev = d_ev * z_ev * scoreable
+    ev = jnp.where(jnp.isfinite(ev), ev, 0.0)
+    return jnp.where(reported > 0, decay * rep + (1.0 - decay) * ev, rep)
 
 
 def _flat_deltas(params, stacked) -> jax.Array:

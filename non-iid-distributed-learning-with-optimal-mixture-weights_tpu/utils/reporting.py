@@ -65,9 +65,13 @@ def fault_summary(fault_counts: dict) -> dict:
     any fault at all."""
     kinds = ("dropped", "straggled", "corrupted", "quarantined")
     arrs = {k: np.asarray(fault_counts[k], dtype=int) for k in kinds}
-    any_fault = sum(arrs[k] for k in ("dropped", "straggled", "corrupted"))
+    # "lied" (work-fraction liars, fedcore.faults lie=) is optional so
+    # records from before the reputation plane still summarize
+    if "lied" in fault_counts:
+        arrs["lied"] = np.asarray(fault_counts["lied"], dtype=int)
+    any_fault = sum(arrs[k] for k in arrs if k != "quarantined")
     return {
-        **{f"total_{k}": int(arrs[k].sum()) for k in kinds},
+        **{f"total_{k}": int(arrs[k].sum()) for k in arrs},
         "rounds": int(next(iter(arrs.values())).shape[0]),
         "rounds_with_faults": int(np.count_nonzero(any_fault)),
         "worst_round_faults": int(any_fault.max()) if any_fault.size else 0,
@@ -80,9 +84,11 @@ def format_fault_report(name: str, fault_counts: dict) -> str:
     invariant the quarantine is supposed to hold — every non-finite
     report caught (quarantined >= corrupted for nan/inf modes)."""
     s = fault_summary(fault_counts)
+    lied = (f"{s['total_lied']} lied-frac, " if s.get("total_lied")
+            else "")
     return (f"{name} faults: {s['total_dropped']} dropped, "
             f"{s['total_straggled']} straggled, "
-            f"{s['total_corrupted']} corrupted, "
+            f"{s['total_corrupted']} corrupted, {lied}"
             f"{s['total_quarantined']} quarantined over "
             f"{s['rounds_with_faults']}/{s['rounds']} rounds "
             f"(worst round: {s['worst_round_faults']} faulty clients)")
@@ -102,6 +108,28 @@ def defense_summary(defense: dict) -> dict:
         out["total_z_quarantined"] = int(zq.sum())
         out["rounds_with_z_quarantine"] = int(np.count_nonzero(zq))
         out["max_z"] = float(np.max(defense["z_max"]))
+    if "z_threshold" in defense:
+        # quarantine:auto — where the auto-tuned threshold started and
+        # where the observed clean-z distribution steered it
+        thr = np.asarray(defense["z_threshold"], dtype=float)
+        out["z_threshold_first"] = float(thr[0])
+        out["z_threshold_final"] = float(thr[-1])
+    if "reputation" in defense:
+        rep = np.asarray(defense["reputation"], dtype=float)
+        valid = np.asarray(
+            defense.get("client_valid", np.ones(rep.shape[1])),
+            dtype=bool)
+        idx = np.flatnonzero(valid)
+        final = rep[-1][idx]
+        out["rep_final_mean"] = float(final.mean())
+        out["rep_least_trusted"] = (int(idx[final.argmin()]),
+                                    float(final.min()))
+        rg = np.asarray(defense["rep_gated"], dtype=int)
+        out["total_rep_gated"] = int(rg.sum())
+        out["rounds_with_rep_gate"] = int(np.count_nonzero(rg))
+    if "frac_clamped" in defense:
+        fc = np.asarray(defense["frac_clamped"], dtype=int)
+        out["total_frac_clamped"] = int(fc.sum())
     if "krum_pick_counts" in defense:
         picks = np.asarray(defense["krum_pick_counts"], dtype=int)
         # restrict the per-client stats to REAL clients: inert padded
@@ -138,6 +166,20 @@ def format_defense_report(name: str, defense: dict) -> str:
             f"{s['total_z_quarantined']} z-quarantined over "
             f"{s['rounds_with_z_quarantine']} rounds "
             f"(max z {s['max_z']:.2f})")
+    if "z_threshold_final" in s:
+        bits.append(
+            f"auto z threshold {s['z_threshold_first']:.2f} -> "
+            f"{s['z_threshold_final']:.2f}")
+    if "rep_final_mean" in s:
+        li, lv = s["rep_least_trusted"]
+        bits.append(
+            f"reputation: mean {s['rep_final_mean']:.2f} final, "
+            f"client {li} least trusted at {lv:.2f}, "
+            f"{s['total_rep_gated']} rep-gated over "
+            f"{s['rounds_with_rep_gate']} rounds")
+    if "total_frac_clamped" in s:
+        bits.append(
+            f"{s['total_frac_clamped']} work-fraction claims clamped")
     if "krum_most_picked" in s:
         mi, mc = s["krum_most_picked"]
         li, lc = s["krum_least_picked"]

@@ -26,10 +26,12 @@ def test_bench_emits_driver_contract_json():
         BENCH_TORCH_ROUNDS="1", BENCH_AMW_TORCH_ROUNDS="1",
         BENCH_REF_ROUNDS="1", BENCH_AMW_REF_ROUNDS="1",
     )
-    # ambient knobs that would flip the asserted defended-leg shape
-    # (a developer shell may export them)
+    # ambient knobs that would flip the asserted defended-leg /
+    # reputation-leg shape (a developer shell may export them)
     for k in ("BENCH_NO_DEFENDED", "BENCH_DEFENDED",
-              "BENCH_DEFENDED_AGG", "BENCH_DEFENDED_FAULTS"):
+              "BENCH_DEFENDED_AGG", "BENCH_DEFENDED_FAULTS",
+              "BENCH_NO_REPUTATION", "BENCH_REPUTATION_AGG",
+              "BENCH_REPUTATION_FAULTS"):
         env.pop(k, None)
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
@@ -37,7 +39,7 @@ def test_bench_emits_driver_contract_json():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [json.loads(ln) for ln in out.stdout.splitlines() if ln]
-    assert len(lines) == 4
+    assert len(lines) == 5
     # headline LAST (the driver records the final line), and its
     # kill-safety duplicate printed BEFORE the defended leg's runs
     assert lines[-1]["metric"] == "client_updates_per_sec"
@@ -61,6 +63,17 @@ def test_bench_emits_driver_contract_json():
     assert dfd["faulted_mean_updates_per_sec"] > 0
     assert "mkrum" in dfd["robust_agg"]
     assert dfd["platform"] == "cpu"
+    # the reputation-round leg (ISSUE 4): the stateful cross-round
+    # defense (rep EWMA + auto-tuned z threshold) vs the same faulted
+    # plain mean
+    rep = lines[3]
+    assert rep["metric"] == "reputation_round_overhead"
+    assert rep["value"] > 0
+    assert rep["unit"] == "x-vs-faulted-mean"
+    assert rep["reputation_updates_per_sec"] > 0
+    assert rep["faulted_mean_updates_per_sec"] > 0
+    assert "rep" in rep["robust_agg"]
+    assert rep["platform"] == "cpu"
     # driver-captured roofline fields (PERFORMANCE.md § MFU)
     assert lines[-1]["flops_per_update"] > 0
     assert lines[-1]["achieved_gflops"] > 0
@@ -69,8 +82,9 @@ def test_bench_emits_driver_contract_json():
 def test_bench_cpu_fallback_contract():
     """The unattended fallback path (what the driver captures with the
     tunnel down): headline printed FIRST for kill-safety AND LAST for
-    the parse contract, reference/torch FedAMW arms skipped, and — with
-    a warm cache — a JAX-only FedAMW datapoint between them.
+    the parse contract, reference/torch FedAMW arms skipped, a JAX-only
+    FedAMW datapoint with a warm cache — and the reputation leg, whose
+    contract promises the metric on BOTH the full and fallback paths.
     BENCH_FORCE_FALLBACK skips the 180 s probe, which is also what
     makes this path testable."""
     env = dict(os.environ)
@@ -85,7 +99,8 @@ def test_bench_cpu_fallback_contract():
     for k in ("BENCH_ROUNDS", "BENCH_CPU_FALLBACK_FULL",
               "BENCH_REF_ROUNDS", "BENCH_NO_PALLAS",
               "BENCH_NO_REFERENCE", "BENCH_DEFENDED",
-              "BENCH_NO_DEFENDED"):
+              "BENCH_NO_DEFENDED", "BENCH_NO_REPUTATION",
+              "BENCH_REPUTATION_AGG", "BENCH_REPUTATION_FAULTS"):
         env.pop(k, None)
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
@@ -96,13 +111,17 @@ def test_bench_cpu_fallback_contract():
     # the defended leg defers to headline kill-safety in fallback
     assert "defended leg skipped in CPU fallback" in out.stderr
     lines = [json.loads(ln) for ln in out.stdout.splitlines() if ln]
-    assert len(lines) == 3
+    assert len(lines) == 4
     assert lines[0] == lines[-1]  # kill-safety duplicate of the headline
     assert lines[-1]["metric"] == "client_updates_per_sec"
     assert lines[-1]["platform"] == "cpu"
     assert lines[-1]["baseline_arm"] == "torch-backend"
     assert lines[1]["metric"] == "fedamw_client_updates_per_sec"
     assert "vs_baseline" not in lines[1]  # no baseline arm in fallback
+    # the reputation leg runs in fallback too (both-paths contract)
+    assert lines[2]["metric"] == "reputation_round_overhead"
+    assert lines[2]["value"] > 0
+    assert "rep" in lines[2]["robust_agg"]
 
 
 def test_bench_fallback_defended_headline_kill_safety():
@@ -120,7 +139,9 @@ def test_bench_fallback_defended_headline_kill_safety():
     )
     for k in ("BENCH_ROUNDS", "BENCH_CPU_FALLBACK_FULL",
               "BENCH_REF_ROUNDS", "BENCH_NO_DEFENDED",
-              "BENCH_DEFENDED_AGG", "BENCH_DEFENDED_FAULTS"):
+              "BENCH_DEFENDED_AGG", "BENCH_DEFENDED_FAULTS",
+              "BENCH_NO_REPUTATION", "BENCH_REPUTATION_AGG",
+              "BENCH_REPUTATION_FAULTS"):
         env.pop(k, None)
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
@@ -128,10 +149,11 @@ def test_bench_fallback_defended_headline_kill_safety():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [json.loads(ln) for ln in out.stdout.splitlines() if ln]
-    assert len(lines) == 3
+    assert len(lines) == 4
     assert lines[0] == lines[-1]  # kill-safety duplicate
     assert lines[0]["metric"] == "client_updates_per_sec"
     assert lines[1]["metric"] == "defended_round_overhead"
+    assert lines[2]["metric"] == "reputation_round_overhead"
 
 
 def test_bench_strict_tpu_refuses_cpu_backend():

@@ -107,6 +107,48 @@ def test_spec_parse_full_syntax():
                           corrupt_scale=7.5, seed=9)
 
 
+def test_spec_parse_lie_and_plan_report():
+    """The lie mode (ISSUE 4): a lying cell does FULL work (scale 1,
+    update untouched) but its REPORTED fraction is lie_frac — the
+    FedNova tau inflation attack. The plan's report row carries the
+    claim; honest cells derive their report from the straggle row."""
+    s = FaultSpec.parse("lie=0.3:0.01, straggle=0.2:0.5, seed=4")
+    assert s.lie == 0.3 and s.lie_frac == 0.01
+    with pytest.raises(ValueError, match="lie_frac"):
+        FaultSpec(lie=0.1, lie_frac=0.0)
+    with pytest.raises(ValueError, match="sum"):
+        FaultSpec(drop=0.5, straggle=0.3, lie=0.3)
+    plan = FaultPlan.build(s, rounds=8, num_clients=12)
+    assert plan.lie.sum() > 0
+    # mutually exclusive roles, full work on lying cells
+    assert ((plan.lie + plan.straggle + plan.drop
+             + plan.corrupt).max() <= 1.0)
+    np.testing.assert_array_equal(plan.scale[plan.lie > 0], 1.0)
+    np.testing.assert_array_equal(plan.report[plan.lie > 0],
+                                  np.float32(0.01))
+    np.testing.assert_array_equal(plan.report[plan.straggle > 0], 0.5)
+    clean = (plan.lie == 0) & (plan.straggle == 0)
+    np.testing.assert_array_equal(plan.report[clean], 1.0)
+    # rows() ships the REPORTED fraction as the tau_frac row
+    tau = np.asarray(plan.rows(0, 8)[4])
+    np.testing.assert_array_equal(tau, plan.report)
+    # a lie mask WITHOUT the claimed fractions must refuse loudly: the
+    # derived report would be 1.0 on lying cells (a clean plan) while
+    # fault_counts still labeled them "lied"
+    with pytest.raises(ValueError, match="report"):
+        FaultPlan(plan.drop, plan.straggle, plan.corrupt, plan.scale,
+                  plan.poison, plan.fill, lie=plan.lie)
+
+
+def test_rep_parse_error_names_the_malformed_field():
+    """'rep:0.9:abc' is a FLOOR problem — the decay is valid and the
+    error must not point the operator at it."""
+    with pytest.raises(ValueError, match="floor"):
+        parse_robust_spec("rep:0.9:abc")
+    with pytest.raises(ValueError, match="decay"):
+        parse_robust_spec("rep:abc:0.2")
+
+
 @pytest.mark.parametrize("bad", [
     "drop=1.5", "drop=0.6,straggle=0.6", "straggle=0.1:0",
     "corrupt=0.1:bogus", "corrupt=0.1:scale:inf", "typo=1",
@@ -199,6 +241,16 @@ def test_trimmed_mean_drops_extremes_and_falls_back():
      RobustSpec(agg="mkrum", mkrum_m=6, zscore=3.0)),
     ("clip:5+quarantine:2+geomed:4",
      RobustSpec(agg="geomed", geomed_iters=4, clip=5.0, zscore=2.0)),
+    ("quarantine:auto", RobustSpec(zscore_auto=True)),
+    ("rep", RobustSpec(rep_decay=0.9, rep_floor=0.2)),
+    ("rep:0.5", RobustSpec(rep_decay=0.5, rep_floor=0.2)),
+    ("rep:0.5:0.1", RobustSpec(rep_decay=0.5, rep_floor=0.1)),
+    ("rep:0.9:0", RobustSpec(rep_decay=0.9, rep_floor=0.0)),
+    ("rep:0.9+quarantine:3.5",
+     RobustSpec(zscore=3.5, rep_decay=0.9, rep_floor=0.2)),
+    ("rep:0.8:0.25+quarantine:auto+mkrum:4",
+     RobustSpec(agg="mkrum", mkrum_m=4, zscore_auto=True,
+                rep_decay=0.8, rep_floor=0.25)),
 ])
 def test_parse_robust_spec(spec, want):
     assert parse_robust_spec(spec) == want
@@ -211,7 +263,12 @@ def test_parse_robust_spec(spec, want):
                                  "mkrum:0", "geomed:0", "geomed:x",
                                  "quarantine:0", "quarantine:nan",
                                  "quarantine:inf", "krum+mkrum:2",
-                                 "quarantine:2+quarantine:3", "bogus"])
+                                 "quarantine:2+quarantine:3", "bogus",
+                                 "rep:0", "rep:1", "rep:nan", "rep:x",
+                                 "rep:0.9:1", "rep:0.9:-0.1",
+                                 "rep:0.9:0.2:7", "rep+rep:0.5",
+                                 "quarantine:auto+quarantine:3",
+                                 "quarantine:aut0"])
 def test_parse_robust_spec_rejects(bad):
     """Includes the silent-fallback spellings: 'median+mean' must not
     quietly run the plain average the user opted out of, and duplicate
@@ -229,6 +286,12 @@ ACCEPTED_SPELLINGS = [
     "krum", "mkrum:1", "mkrum:4", "geomed", "geomed:3",
     "quarantine", "quarantine:2.5", "quarantine:3+mkrum:6",
     "clip:5+quarantine:2+geomed:4", "mkrum:2+clip:1+quarantine:1.5",
+    # the stateful tokens (ISSUE 4): cross-round reputation and the
+    # auto-tuned quarantine threshold, alone and composed
+    "rep", "rep:0.5", "rep:0.9:0.3", "REP:0.5 : 0.1",
+    "quarantine:auto", "QUARANTINE:AUTO", "rep:0.9+quarantine:auto",
+    "rep:0.9:0.2+quarantine:3.5",
+    "clip:5+quarantine:auto+rep:0.8:0.25+mkrum:4",
 ]
 
 
@@ -837,11 +900,16 @@ def test_fault_plan_change_adds_no_recompile(setup8):
 
 @pytest.mark.parametrize("agg", ["krum", "mkrum:3", "geomed:4",
                                  "quarantine:3",
-                                 "clip:5+quarantine:3+mkrum:6"])
+                                 "clip:5+quarantine:3+mkrum:6",
+                                 "rep:0.5:0.2", "quarantine:auto",
+                                 "rep:0.9:0.2+quarantine:auto",
+                                 "rep:0.8:0.1+quarantine:4+mkrum:6"])
 def test_new_defense_tokens_compile_one_round_program(setup8, agg):
-    """ISSUE 3 acceptance: every new spec token compiles exactly one
-    round program across varying per-round fault plans — the defense
-    is program STRUCTURE, the plan is data."""
+    """ISSUE 3/4 acceptance: every new spec token — including the
+    STATEFUL ones, whose cross-round reputation / auto-threshold state
+    rides the scan carry as fixed-shape leaves — compiles exactly one
+    round program across varying per-round fault plans: the defense is
+    program STRUCTURE, the plan (and the carried state) is data."""
     FedAvg(setup8, faults="corrupt=0.3:sign,seed=1", robust_agg=agg,
            **KW)
     fn = core._LAST_TRAIN_FN

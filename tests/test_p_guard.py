@@ -133,11 +133,18 @@ def test_guard_keeps_diverging_trial_finite(guard):
 
 
 def test_guard_keeps_real_sweep_trial_finite(monkeypatch):
-    """The ACTUAL diverging operating point from the committed
-    regression sweep (TUNING_regression.md row: lr_p=0.005,
-    lambda_reg=1e-05 on synthetic_nonlinear — nan at R=50; reproduced
-    nan at R=10 here), end to end through FedAMW: unguarded it blows
-    up, FEDAMW_P_GUARD=simplex keeps every metric finite."""
+    """The regression-sweep divergence cliff (TUNING_regression.md:
+    unconstrained p diverges on synthetic_nonlinear at hot lr_p), end
+    to end through FedAMW: unguarded it blows up, FEDAMW_P_GUARD=
+    simplex keeps every metric finite.
+
+    The original sweep row (lr_p=0.005, lambda_reg=1e-05, nan at R=50,
+    reproduced at R=10 when this test shipped) stopped diverging at
+    R=10 somewhere before PR 4 (measured: finite through lr_p=0.01,
+    nan from lr_p=0.02) — the cliff moved, it did not close. lr_p is
+    pinned at 2e-2, past today's edge, so the test keeps exercising
+    the divergence the guard exists for; the precondition assert below
+    still fails loudly if the cliff ever moves past it again."""
     from fedamw_tpu.algorithms import FedAMW, prepare_setup
     from fedamw_tpu.config import get_parameter
     from fedamw_tpu.data import load_dataset
@@ -149,7 +156,7 @@ def test_guard_keeps_real_sweep_trial_finite(monkeypatch):
                           kernel_type=params["kernel_type"], seed=7,
                           rng=rng)
     kw = dict(lr=params["lr"], epoch=2, round=10, lambda_reg=1e-5,
-              lr_p=5e-3, seed=0, lr_mode="reference")
+              lr_p=2e-2, seed=0, lr_mode="reference")
     monkeypatch.delenv("FEDAMW_P_GUARD", raising=False)
     tl_un = np.asarray(FedAMW(setup, **kw)["test_loss"])
     assert not np.all(np.isfinite(tl_un)), (

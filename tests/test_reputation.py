@@ -1,0 +1,405 @@
+"""The stateful cross-round reputation plane (ISSUE 4).
+
+Load-bearing contracts:
+
+- the `rep[:decay[:floor]]` / `quarantine:auto` grammar parses,
+  canonicalizes, and composes (the spelling sweep lives in
+  tests/test_faults.py; the conftest round-trip guard covers every
+  parse here too);
+- directional scores separate a norm-preserving sign flip (invisible
+  to ANY norm test) from honest non-IID heterogeneity at O(JP);
+- the self-REPORTED work fraction is trust-clamped: a client claiming
+  frac=0.01 while doing full-norm work gets its claim bumped by the
+  norm cross-check (and replaced by the cohort median as its
+  reputation drops), so it gains no FedNova tau advantage;
+- reputation DYNAMICS: a persistent sign-flipper's reputation decays
+  geometrically to the floor and STAYS gated; an honest client
+  transiently corrupted by the fault plan regains weight within
+  O(1/(1-decay)) rounds of the corruption ending;
+- `quarantine:auto` starts at the hand-tuned Z=5 operating point,
+  catches a 25x scale attack exactly like the static threshold
+  (array-equal to the clean-drop run), and never fires on a clean run;
+- telemetry (reputation trajectories, gate counts, clamped-frac
+  counts, threshold trajectory) reaches res["defense"] and the
+  reporting layer.
+
+The zero-recompile contract for the new tokens is pinned in
+tests/test_faults.py::test_new_defense_tokens_compile_one_round_program.
+"""
+
+import numpy as np
+import pytest
+
+from fedamw_tpu.algorithms import FedAMW, FedAvg, FedNova, prepare_setup
+from fedamw_tpu.data import load_dataset
+from fedamw_tpu.fedcore.aggregate import fednova_effective_weights
+from fedamw_tpu.fedcore.faults import FaultPlan
+from fedamw_tpu.fedcore.robust import (REP_DECAY_DEFAULT,
+                                       REP_FLOOR_DEFAULT, Z_AUTO_MAX,
+                                       Z_AUTO_MIN, directional_scores,
+                                       parse_robust_spec,
+                                       reputation_update,
+                                       trust_bounded_work_frac)
+
+pytestmark = [pytest.mark.faults, pytest.mark.reputation]
+
+
+@pytest.fixture(scope="module")
+def setup_iid():
+    """Near-IID digits (alpha=100): client deltas cluster tightly, so
+    the directional signal is crisp — the regime where the gating
+    dynamics contracts are sharp. (Under extreme heterogeneity the
+    one-round cosine signal weakens and `rep` degrades to soft
+    down-weighting around the floor — README 'Cross-round
+    reputation'.)"""
+    ds = load_dataset("digits", num_partitions=8, alpha=100.0)
+    return prepare_setup(ds, kernel_type="linear", seed=3,
+                         rng=np.random.RandomState(3))
+
+
+@pytest.fixture(scope="module")
+def setup_het():
+    """The heterogeneous cohort the rest of the fault suite uses."""
+    ds = load_dataset("digits", num_partitions=8, alpha=0.5)
+    return prepare_setup(ds, kernel_type="linear", seed=3,
+                         rng=np.random.RandomState(3))
+
+
+KW = dict(lr=0.5, epoch=1, seed=0, lr_mode="constant")
+
+
+def sign_plan(R, J, j, rounds_active=None):
+    """Sign-flip client ``j`` on ``rounds_active`` (default: all)."""
+    z = np.zeros((R, J), np.float32)
+    drop, straggle, corrupt = z.copy(), z.copy(), z.copy()
+    scale = np.ones((R, J), np.float32)
+    act = slice(None) if rounds_active is None else rounds_active
+    corrupt[act, j] = 1
+    scale[act, j] = -1.0
+    return FaultPlan(drop, straggle, corrupt, scale, poison=z.copy(),
+                     fill=z.copy())
+
+
+def lie_plan(R, J, j, claim=0.01):
+    """Client ``j`` does FULL work every round but REPORTS ``claim``
+    as its work fraction (the FedNova tau inflation attack)."""
+    z = np.zeros((R, J), np.float32)
+    report = np.ones((R, J), np.float32)
+    report[:, j] = claim
+    lie = z.copy()
+    lie[:, j] = 1.0
+    return FaultPlan(z, z.copy(), z.copy(), np.ones((R, J), np.float32),
+                     z.copy(), z.copy(), report=report, lie=lie)
+
+
+# -- grammar ----------------------------------------------------------
+
+def test_rep_defaults_and_canonical():
+    spec = parse_robust_spec("rep")
+    assert spec.rep_decay == REP_DECAY_DEFAULT
+    assert spec.rep_floor == REP_FLOOR_DEFAULT
+    assert spec.stateful and not spec.is_default
+    assert spec.canonical() == "rep:0.9:0.2"
+    auto = parse_robust_spec("quarantine:auto")
+    assert auto.zscore_auto and auto.zscore is None and auto.stateful
+    assert auto.canonical() == "quarantine:auto"
+    both = parse_robust_spec("rep:0.5:0.1+quarantine:auto+mkrum:4")
+    assert both.canonical() == "quarantine:auto+rep:0.5:0.1+mkrum:4"
+    # the memoryless specs stay memoryless
+    assert not parse_robust_spec("quarantine:5").stateful
+    assert not parse_robust_spec("mkrum:4").stateful
+
+
+# -- directional scores -----------------------------------------------
+
+def test_directional_scores_flag_sign_flip_not_heterogeneity():
+    rng = np.random.RandomState(0)
+    J, P = 8, 40
+    g = {"w": np.zeros(P, np.float32)}
+    base = rng.randn(P).astype(np.float32)
+    x = base[None] + 0.1 * rng.randn(J, P).astype(np.float32)
+    x[2] = -x[2]  # norm-preserving flip
+    cos = np.asarray(directional_scores(
+        g, {"w": x}, np.ones(J, np.float32)))
+    assert cos[2] < -0.8
+    assert np.all(np.delete(cos, 2) > 0.8)
+    # an absent client's garbage never pollutes the median direction
+    x2 = x.copy()
+    x2[5] = 1e6 * rng.randn(P).astype(np.float32)
+    present = np.ones(J, np.float32)
+    present[5] = 0.0
+    cos2 = np.asarray(directional_scores(g, {"w": x2}, present))
+    assert cos2[2] < -0.8 and np.all(cos2[[0, 1, 3, 4, 6, 7]] > 0.8)
+
+
+# -- trust-bounded work fraction --------------------------------------
+
+def test_trust_bounded_work_frac_clamps_liar_spares_straggler():
+    present = np.ones(6, np.float32)
+    norms = np.asarray([1.0, 1.05, 0.95, 1.0, 0.25, 1.0], np.float32)
+    #                   honest x4 ............ straggler  liar
+    frac = np.asarray([1, 1, 1, 1, 0.25, 0.01], np.float32)
+    rep = np.ones(6, np.float32)
+    trusted, n = trust_bounded_work_frac(norms, frac, present, rep)
+    trusted = np.asarray(trusted)
+    # the liar's full-norm work implies ~full-work: bumped to ~0.5
+    # (norm / (FRAC_MARGIN * median full-work-equivalent norm))
+    assert trusted[5] > 0.4
+    # the honest straggler's claim is proportional to its norm — kept
+    np.testing.assert_allclose(trusted[4], 0.25, atol=1e-6)
+    np.testing.assert_allclose(trusted[:4], frac[:4], atol=1e-6)
+    assert int(n) == 1
+    # zero reputation: the claim is replaced by the cohort median
+    rep0 = rep.copy()
+    rep0[5] = 0.0
+    t0 = np.asarray(trust_bounded_work_frac(norms, frac, present,
+                                            rep0)[0])
+    np.testing.assert_allclose(t0[5], 1.0, atol=1e-6)
+    # absent clients pass their claim through untouched
+    absent = present.copy()
+    absent[5] = 0.0
+    ta = np.asarray(trust_bounded_work_frac(norms, frac, absent,
+                                            rep)[0])
+    np.testing.assert_allclose(ta[5], 0.01, atol=1e-9)
+
+
+def test_lie_gains_no_fednova_advantage_after_clamp():
+    """The unit-level attack closure: claiming frac=0.01 at full work
+    inflates the FedNova per-step weight ~100x; after the trust clamp
+    the inflation collapses to the FRAC_MARGIN slack (~2x), and with
+    reputation at zero it vanishes entirely."""
+    J = 6
+    sizes = np.full(J, 100.0, np.float32)
+    p = np.full(J, 1.0 / J, np.float32)
+    norms = np.ones(J, np.float32)
+    frac = np.ones(J, np.float32)
+    frac[5] = 0.01
+    w_lie = np.asarray(fednova_effective_weights(sizes, p, 2, 32,
+                                                 tau_frac=frac))
+    assert w_lie[5] / w_lie[0] > 50.0  # the undefended inflation
+    trusted, _ = trust_bounded_work_frac(
+        norms, frac, np.ones(J, np.float32), np.ones(J, np.float32))
+    w_t = np.asarray(fednova_effective_weights(sizes, p, 2, 32,
+                                               tau_frac=trusted))
+    assert w_t[5] / w_t[0] < 3.0  # clamped to the margin slack
+    rep0 = np.ones(J, np.float32)
+    rep0[5] = 0.0
+    t0, _ = trust_bounded_work_frac(norms, frac,
+                                    np.ones(J, np.float32), rep0)
+    w_0 = np.asarray(fednova_effective_weights(sizes, p, 2, 32,
+                                               tau_frac=t0))
+    np.testing.assert_allclose(w_0[5] / w_0[0], 1.0, rtol=1e-5)
+
+
+# -- reputation update dynamics (unit) --------------------------------
+
+def test_reputation_update_decay_and_recovery_rates():
+    J = 4
+    ones = np.ones(J, np.float32)
+    rep = ones.copy()
+    cos = np.asarray([0.9, 0.85, -0.9, 0.88], np.float32)
+    # three rounds of a flipped client: geometric decay at `decay`
+    for t in range(3):
+        rep = np.asarray(reputation_update(rep, ones, ones, cos, ones,
+                                           None, 3.0, 0.5))
+    assert rep[2] == pytest.approx(0.125, abs=0.02)
+    np.testing.assert_allclose(rep[[0, 1, 3]], 1.0, atol=0.02)
+    # recovery: full evidence pulls rep back within O(1/(1-decay))
+    good = np.abs(cos)
+    for t in range(2):
+        rep = np.asarray(reputation_update(rep, ones, ones, good, ones,
+                                           None, 3.0, 0.5))
+    assert rep[2] > 0.75
+    # an absent client's reputation is frozen either way
+    reported = np.asarray([1, 1, 1, 0], np.float32)
+    before = rep.copy()
+    rep2 = np.asarray(reputation_update(rep, reported, reported, cos,
+                                        reported, None, 3.0, 0.5))
+    assert rep2[3] == before[3]
+    # a non-finite reporter (scoreable=0) earns zero evidence
+    scoreable = np.asarray([0, 1, 1, 1], np.float32)
+    rep3 = np.asarray(reputation_update(ones, ones, scoreable, good,
+                                        ones, None, 3.0, 0.5))
+    assert rep3[0] == pytest.approx(0.5, abs=1e-5)
+
+
+# -- end-to-end dynamics ----------------------------------------------
+
+def test_persistent_flipper_converges_to_floor_and_stays_gated(
+        setup_iid):
+    R, J = 10, setup_iid.num_clients
+    res = FedAvg(setup_iid, faults=sign_plan(R, J, 2),
+                 robust_agg="rep:0.5:0.2", round=R, **KW)
+    d = res["defense"]
+    rep = d["reputation"]
+    assert np.all(np.isfinite(res["test_loss"]))
+    # geometric decay to (numerically) zero, never back above floor
+    assert rep[2, 2] < 0.2
+    assert np.all(rep[2:, 2] < 0.2)
+    assert rep[-1, 2] < 0.01
+    # gated from the round reputation crossed the floor, every round
+    np.testing.assert_array_equal(d["rep_gated"][2:], 1)
+    # honest clients keep (near-)full trust and are never gated
+    honest = np.delete(rep[-1], 2)
+    assert honest.min() > 0.5
+    assert d["rep_gated"].max() <= 1
+
+
+def test_transient_corruption_recovers_within_memory_horizon(
+        setup_iid):
+    """An honest client corrupted for rounds 0-2 only must regain
+    weight within O(1/(1-decay)) rounds of the corruption ending:
+    with decay=0.5 (memory ~2 rounds), reputation is back above the
+    gate floor within 2 rounds and near full trust by the horizon."""
+    R, J = 10, setup_iid.num_clients
+    res = FedAvg(setup_iid,
+                 faults=sign_plan(R, J, 2, rounds_active=slice(0, 3)),
+                 robust_agg="rep:0.5:0.2", round=R, **KW)
+    rep = res["defense"]["reputation"][:, 2]
+    assert rep[2] < 0.2          # distrusted while corrupted
+    assert rep[4] > 0.2          # back above the floor in <= 2 rounds
+    assert rep[-1] > 0.9         # near-full trust by the horizon
+    # and the gate actually lifted: no rep-gating in the tail
+    np.testing.assert_array_equal(
+        res["defense"]["rep_gated"][5:], 0)
+
+
+def test_fedamw_rep_gate_zeroes_learned_mass(setup_iid):
+    """Reputation gates the present mask BEFORE the p-solve (same
+    mechanism as krum selection / dropout), so a gated client's
+    learned mixture weight is masked to exactly zero and stays there
+    while gated."""
+    R, J = 8, setup_iid.num_clients
+    kw = dict(lambda_reg=1e-4, lr_p=1e-3, round=R, **KW)
+    res = FedAMW(setup_iid, faults=sign_plan(R, J, 2),
+                 robust_agg="rep:0.5:0.2", return_state=True, **kw)
+    assert np.all(np.isfinite(res["test_loss"]))
+    assert res["defense"]["reputation"][-1, 2] < 0.2
+    assert float(np.asarray(res["p"])[2]) == 0.0
+    # the undefended run keeps nonzero mass on the attacker — the
+    # zero is the gate's doing, not the solver's
+    und = FedAMW(setup_iid, faults=sign_plan(R, J, 2),
+                 return_state=True, **kw)
+    assert float(np.asarray(und["p"])[2]) != 0.0
+
+
+def test_lie_attack_clamped_and_defended_run_tracks_clean(setup_het):
+    """The e2e attack closure on FedNova: a full-work client claiming
+    frac=0.01 drags the undefended run far from clean (its per-step
+    weight is ~100x); under `rep` the claim is clamped every round
+    (frac_clamped telemetry) and the defended trajectory stays close
+    to the clean one."""
+    R, J = 6, setup_het.num_clients
+    plan = lie_plan(R, J, 2)
+    clean = FedNova(setup_het, return_state=True, round=R, **KW)
+    lied = FedNova(setup_het, faults=plan, return_state=True, round=R,
+                   **KW)
+    defended = FedNova(setup_het, faults=plan,
+                       robust_agg="rep:0.5:0.2", return_state=True,
+                       round=R, **KW)
+    assert np.all(np.isfinite(defended["test_loss"]))
+    np.testing.assert_array_equal(
+        defended["defense"]["frac_clamped"], np.full(R, 1))
+    np.testing.assert_array_equal(
+        lied["fault_counts"]["lied"], np.full(R, 1))
+    cw = np.asarray(clean["params"]["w"])
+    err_lied = np.linalg.norm(np.asarray(lied["params"]["w"]) - cw)
+    err_dfd = np.linalg.norm(np.asarray(defended["params"]["w"]) - cw)
+    assert err_dfd < err_lied
+
+
+# -- quarantine:auto --------------------------------------------------
+
+def test_quarantine_auto_catches_scale_attack_like_static(setup_het):
+    """A 25x-scaled client z-scores far beyond the auto threshold
+    every round; the quarantine folds into the same present mask, so
+    the run is array-equal to the same run with that client cleanly
+    dropped — and the threshold telemetry stays inside the clip
+    band."""
+    R, J = 3, setup_het.num_clients
+    plan = sign_plan(R, J, 2)
+    plan.scale[:, 2] = 25.0
+    res = FedAvg(setup_het, faults=plan, robust_agg="quarantine:auto",
+                 return_state=True, round=R, **KW)
+    d = res["defense"]
+    assert d["robust_agg"] == "quarantine:auto"
+    np.testing.assert_array_equal(d["z_quarantined"], np.full(R, 1))
+    thr = np.asarray(d["z_threshold"], float)
+    assert thr[0] == pytest.approx(5.0)  # the hand-tuned start
+    assert np.all((thr >= Z_AUTO_MIN) & (thr <= Z_AUTO_MAX))
+    z = np.zeros((R, J), np.float32)
+    drop = z.copy()
+    drop[:, 2] = 1
+    dropped = FedAvg(setup_het,
+                     faults=FaultPlan(drop, z, z.copy(),
+                                      np.ones((R, J), np.float32),
+                                      z.copy(), z.copy()),
+                     return_state=True, round=R, **KW)
+    np.testing.assert_array_equal(np.asarray(res["params"]["w"]),
+                                  np.asarray(dropped["params"]["w"]))
+    np.testing.assert_array_equal(res["test_acc"], dropped["test_acc"])
+
+
+def test_quarantine_auto_spares_clean_run(setup_het):
+    """No faults: the adaptive threshold must never fire on honest
+    heterogeneity (digits tops out near z ~ 3.3; the threshold starts
+    at 5 and its running clean-quantile basis keeps it above the
+    observed max), leaving the run bitwise the clean run."""
+    R = 6
+    clean = FedAvg(setup_het, return_state=True, round=R, **KW)
+    res = FedAvg(setup_het, robust_agg="quarantine:auto",
+                 return_state=True, round=R, **KW)
+    assert res["defense"]["z_quarantined"].sum() == 0
+    np.testing.assert_array_equal(np.asarray(res["params"]["w"]),
+                                  np.asarray(clean["params"]["w"]))
+    thr = np.asarray(res["defense"]["z_threshold"], float)
+    assert np.all(thr > np.asarray(res["defense"]["z_max"]).max())
+
+
+# -- telemetry / reporting --------------------------------------------
+
+def test_defense_report_carries_reputation_and_threshold(setup_het):
+    from fedamw_tpu.utils.reporting import (defense_summary,
+                                            format_defense_report,
+                                            format_fault_report)
+
+    R, J = 6, setup_het.num_clients
+    res = FedAvg(setup_het, faults=lie_plan(R, J, 2),
+                 robust_agg="rep:0.5:0.2+quarantine:auto", round=R,
+                 **KW)
+    d = res["defense"]
+    assert d["reputation"].shape == (R, J)
+    s = defense_summary(d)
+    assert s["robust_agg"] == "quarantine:auto+rep:0.5:0.2"
+    assert 0.0 <= s["rep_final_mean"] <= 1.0
+    assert s["total_frac_clamped"] >= R  # the liar, every round
+    assert s["z_threshold_first"] == pytest.approx(5.0)
+    line = format_defense_report("FedAvg", d)
+    assert "reputation:" in line and "auto z threshold" in line
+    assert "work-fraction claims clamped" in line
+    fline = format_fault_report("FedAvg", res["fault_counts"])
+    assert "lied-frac" in fline
+    # fault_summary tolerates pre-PR-4 records without a "lied" key
+    from fedamw_tpu.utils.reporting import fault_summary
+    legacy = {k: v for k, v in res["fault_counts"].items()
+              if k != "lied"}
+    assert "total_lied" not in fault_summary(legacy)
+
+
+def test_rep_soft_only_mode_downweights_without_gating(setup_het):
+    """floor=0 is soft-only: nobody is ever hard-gated, but the
+    flipper's reputation (and so its relative weight) still sinks —
+    the run differs from the undefended one and stays finite."""
+    R, J = 6, setup_het.num_clients
+    plan = sign_plan(R, J, 2)
+    res = FedAvg(setup_het, faults=plan, robust_agg="rep:0.5:0",
+                 return_state=True, round=R, **KW)
+    assert np.all(np.isfinite(res["test_loss"]))
+    d = res["defense"]
+    assert d["rep_gated"].sum() == 0
+    rep = d["reputation"][-1]
+    assert rep[2] < np.delete(rep, 2).min()
+    und = FedAvg(setup_het, faults=plan, return_state=True, round=R,
+                 **KW)
+    assert not np.array_equal(np.asarray(res["params"]["w"]),
+                              np.asarray(und["params"]["w"]))
