@@ -62,8 +62,14 @@ BENCH_DEFENDED_AGG / BENCH_DEFENDED_FAULTS (the ISSUE 3
 defense-overhead leg; see bench_defended), BENCH_NO_REPUTATION /
 BENCH_REPUTATION_AGG / BENCH_REPUTATION_FAULTS (the ISSUE 4 stateful
 reputation-overhead leg, emitted on BOTH the full and fallback paths;
-see bench_reputation), BENCH_PROFILE
-(set to a directory to capture a jax.profiler trace of the timed run).
+see bench_reputation), BENCH_NO_TRACE / BENCH_TRACE_OVERHEAD=1 (the
+ISSUE 5 trace-plane cost leg — tracing on vs off on the same compiled
+program; opt-IN on the fallback path; see bench_trace_overhead),
+BENCH_PROFILE_DIR (jax.profiler capture of the timed run, shared with
+serve_bench via bench_common.profile_ctx; the legacy BENCH_PROFILE
+spelling is still honored). The headline line carries a "phases"
+breakdown (build / compile-warmup / timed-run seconds) of the winning
+leg.
 """
 
 import contextlib
@@ -98,38 +104,53 @@ def build_dataset(num_clients: int):
 
 
 def _profile_ctx():
-    trace_dir = os.environ.get("BENCH_PROFILE")
-    if trace_dir:
-        import jax
+    # shared with serve_bench.py (bench_common.profile_ctx): honors
+    # BENCH_PROFILE_DIR (per-tool subdirectory) and the legacy
+    # BENCH_PROFILE spelling this driver shipped with
+    from bench_common import profile_ctx
 
-        return jax.profiler.trace(trace_dir)
-    return contextlib.nullcontext()
+    return profile_ctx("bench")
 
 
 def bench_jax(ds, D, rounds, algorithm="FedAvg", epoch=EPOCHS, batch_size=32,
-              lr=0.5, **kw):
+              lr=0.5, phases=None, **kw):
+    """One timed leg. ``phases`` (optional dict) receives the
+    phase-attributed wall-clock breakdown — ``build_s`` (data/setup
+    construction), ``compile_warmup_s`` (the untimed warmup run that
+    compiles+caches the scan program), ``timed_run_s`` — so the
+    headline throughput number carries WHERE the leg's wall-clock went
+    instead of a single end-to-end figure."""
     from fedamw_tpu import algorithms
     from fedamw_tpu.algorithms import prepare_setup
 
+    t_b0 = time.perf_counter()
     setup = prepare_setup(ds, D=D, kernel_par=0.1, seed=100,
                           rng=np.random.RandomState(100),
                           buckets=int(os.environ.get("BENCH_BUCKETS", "32")))
+    build_s = time.perf_counter() - t_b0
     J = setup.num_clients
     fn = getattr(algorithms, algorithm)
 
     # warmup with the SAME round count: the whole run is one scan program,
     # so a different length would recompile; this caches the real one
+    t_w0 = time.perf_counter()
     fn(setup, lr=lr, epoch=epoch, batch_size=batch_size, round=rounds,
        seed=0, lr_mode="constant", **kw)
+    warm_s = time.perf_counter() - t_w0
     with _profile_ctx():
         t0 = time.perf_counter()
         res = fn(setup, lr=lr, epoch=epoch, batch_size=batch_size,
                  round=rounds, seed=0, lr_mode="constant", **kw)
         dt = time.perf_counter() - t0
+    if phases is not None:
+        phases.clear()
+        phases.update(build_s=round(build_s, 3),
+                      compile_warmup_s=round(warm_s, 3),
+                      timed_run_s=round(dt, 3))
     return J * rounds / dt, float(res["test_acc"][-1]), dt
 
 
-def bench_jax_best(ds, D, rounds, algorithm="FedAvg", **kw):
+def bench_jax_best(ds, D, rounds, algorithm="FedAvg", phases=None, **kw):
     """Benchmark the XLA path, then (unless BENCH_NO_PALLAS is set) the
     fused Pallas kernels, and keep the faster run.
 
@@ -138,10 +159,13 @@ def bench_jax_best(ds, D, rounds, algorithm="FedAvg", **kw):
     candidate only wins if its final accuracy matches the XLA run
     (same seeds and shuffle streams -> same math, so a mismatch means
     the kernel is wrong, not "different"). Returns
-    (updates/s, acc, seconds, impl_label).
+    (updates/s, acc, seconds, impl_label); ``phases`` (optional dict)
+    receives the WINNING candidate's phase breakdown (see bench_jax).
     """
     saved = {k: os.environ.get(k) for k in ("FEDAMW_KERNEL",
                                             "FEDAMW_PSOLVER")}
+    leg_phases: dict = {}
+    best_phases: dict = {}
     try:
         # pin the baseline leg explicitly: this must stay the pure-XLA
         # program regardless of what 'auto' resolves to (round 4
@@ -149,8 +173,10 @@ def bench_jax_best(ds, D, rounds, algorithm="FedAvg", **kw):
         # cross-check valid under any future default)
         os.environ["FEDAMW_KERNEL"] = "xla"
         os.environ["FEDAMW_PSOLVER"] = "xla"
-        xla = bench_jax(ds, D, rounds, algorithm=algorithm, **kw)
+        xla = bench_jax(ds, D, rounds, algorithm=algorithm,
+                        phases=leg_phases, **kw)
         best = (*xla, "xla")
+        best_phases = dict(leg_phases)
         print(f"# {algorithm} leg xla: {xla[0]:.1f} updates/s "
               f"(acc {xla[1]:.2f})", file=sys.stderr)
         if os.environ.get("BENCH_NO_PALLAS"):
@@ -193,7 +219,8 @@ def bench_jax_best(ds, D, rounds, algorithm="FedAvg", **kw):
             try:
                 os.environ["FEDAMW_KERNEL"] = kern
                 os.environ["FEDAMW_PSOLVER"] = psolv
-                cand = bench_jax(ds, D, rounds, algorithm=algorithm, **kw)
+                cand = bench_jax(ds, D, rounds, algorithm=algorithm,
+                                 phases=leg_phases, **kw)
                 print(f"# {algorithm} leg {kern}+{psolv}: "
                       f"{cand[0]:.1f} updates/s (acc {cand[1]:.2f})",
                       file=sys.stderr)
@@ -204,6 +231,7 @@ def bench_jax_best(ds, D, rounds, algorithm="FedAvg", **kw):
                 elif cand[0] > best[0]:
                     best = (*cand, f"{kern}+{psolv}"
                             if algorithm == "FedAMW" else kern)
+                    best_phases = dict(leg_phases)
             except Exception as e:  # pragma: no cover - platform-dep.
                 failed = True
                 print(f"# {algorithm} {kern}+{psolv} leg unavailable: "
@@ -214,6 +242,12 @@ def bench_jax_best(ds, D, rounds, algorithm="FedAvg", **kw):
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+        if phases is not None:
+            # the winner's breakdown, whatever path returned (the
+            # monkeypatched-bench_jax contract test never fills
+            # leg_phases; an empty dict is the honest answer there)
+            phases.clear()
+            phases.update(best_phases)
     return best
 
 
@@ -307,6 +341,50 @@ def bench_reputation(ds, D, rounds, num_clients, platform):
         "faulted_mean_updates_per_sec": round(mean_ups, 2),
         "robust_agg": agg,
         "faults": faults,
+        "platform": platform,
+    }
+
+
+def bench_trace_overhead(ds, D, rounds, platform):
+    """CPU-safe trace-plane cost leg (ISSUE 5): time the same FedAvg
+    run twice — the process-global tracer disabled, then enabled
+    (``utils.trace.configure``; what ``exp.py --trace_dir`` turns on)
+    — and report the ratio. The traced run records the train-scan span
+    plus per-round records host-side AFTER the dispatch returns, so
+    the expected overhead is ~zero; this leg makes that measured, not
+    assumed. Returns the JSON record or None on failure/skip (a side
+    leg must never cost the headline metric).
+
+    Env: BENCH_NO_TRACE=1 skips."""
+    if os.environ.get("BENCH_NO_TRACE"):
+        return None
+    from fedamw_tpu.utils import trace as trace_mod
+
+    try:
+        off_ups, _, off_dt = bench_jax(ds, D, rounds)
+        tracer = trace_mod.configure(max_spans=10 * rounds + 16)
+        try:
+            on_ups, _, on_dt = bench_jax(ds, D, rounds)
+        finally:
+            trace_mod.configure(enabled=False)
+    except Exception as e:  # pragma: no cover - defensive
+        trace_mod.configure(enabled=False)
+        print(f"# trace-overhead leg failed: {e!r}", file=sys.stderr)
+        return None
+    # the traced leg's warmup ALSO records spans; only the timed run's
+    # matter for the contract (>= 1 scan span + rounds round records)
+    spans = tracer.records()
+    overhead = off_ups / on_ups if on_ups > 0 else float("inf")
+    print(f"# trace leg: traced {on_ups:.1f} updates/s vs untraced "
+          f"{off_ups:.1f} updates/s -> {overhead:.3f}x overhead "
+          f"({len(spans)} spans)", file=sys.stderr)
+    return {
+        "metric": "trace_overhead",
+        "value": round(overhead, 3),
+        "unit": "x-vs-untraced",
+        "traced_updates_per_sec": round(on_ups, 2),
+        "untraced_updates_per_sec": round(off_ups, 2),
+        "spans_recorded": len(spans),
         "platform": platform,
     }
 
@@ -534,7 +612,9 @@ def main():
         _emit_bucket_sweep(ds, D, rounds, platform)
         return
 
-    jax_ups, jax_acc, jax_dt, jax_impl = bench_jax_best(ds, D, rounds)
+    headline_phases: dict = {}
+    jax_ups, jax_acc, jax_dt, jax_impl = bench_jax_best(
+        ds, D, rounds, phases=headline_phases)
     tsetup = make_torch_setup(ds, D)
     torch_ups, torch_acc, torch_dt = bench_torch(ds, D, torch_rounds,
                                                  setup=tsetup)
@@ -603,6 +683,9 @@ def main():
         # directly comparable only to same-basis scale_bench rows
         "flops_basis": _fwd_basis,
         "achieved_gflops": round(jax_ups * flops_upd / 1e9, 2),
+        # phase-attributed wall-clock of the winning leg (build vs
+        # compile-warmup vs the timed run) — the ISSUE 5 bench contract
+        "phases": headline_phases,
     }
     if ref is not None:
         headline["vs_reference_loop"] = round(jax_ups / ref[0], 2)
@@ -677,6 +760,19 @@ def main():
             rec = bench_reputation(ds, D, rounds, num_clients, platform)
             if rec:
                 print(json.dumps(rec))
+        if os.environ.get("BENCH_TRACE_OVERHEAD") == "1":
+            # two more (warm-cache) runs — kept out of the default
+            # fallback trim like the defended leg, opt-in the same way
+            if not headline_printed_early:
+                print(json.dumps(headline))
+                headline_printed_early = True
+            rec = bench_trace_overhead(ds, D, rounds, platform)
+            if rec:
+                print(json.dumps(rec))
+        else:
+            print("# trace-overhead leg skipped in CPU fallback "
+                  "(headline first); set BENCH_TRACE_OVERHEAD=1 to "
+                  "keep it", file=sys.stderr)
         if (os.environ.get("BENCH_SWEEP_BUCKETS")
                 or os.environ.get("BENCH_SWEEP_UNROLL")):
             print("# sweeps skipped in CPU fallback (headline first); "
@@ -730,12 +826,17 @@ def main():
     # captured output (the BENCH_r02-null failure mode; the final
     # re-print below stays THE parsed line)
     if (not os.environ.get("BENCH_NO_DEFENDED")
-            or not os.environ.get("BENCH_NO_REPUTATION")):
+            or not os.environ.get("BENCH_NO_REPUTATION")
+            or not os.environ.get("BENCH_NO_TRACE")):
         print(json.dumps(headline))
     rec = bench_defended(ds, D, rounds, num_clients, platform)
     if rec:
         print(json.dumps(rec))
     rec = bench_reputation(ds, D, rounds, num_clients, platform)
+    if rec:
+        print(json.dumps(rec))
+    # trace-plane cost leg (ISSUE 5): tracing on vs off, measured
+    rec = bench_trace_overhead(ds, D, rounds, platform)
     if rec:
         print(json.dumps(rec))
 

@@ -17,8 +17,13 @@ drift. The two pieces:
   ``BENCH_FORCE_FALLBACK`` can never be harvested as TPU evidence.
   Strict mode dominates every downgrade path; pinned in
   ``tests/test_bench_contract.py`` and ``tests/test_serve_contract.py``.
+- :func:`profile_ctx` — the env-gated ``jax.profiler`` capture both
+  drivers wrap their timed legs in (``BENCH_PROFILE_DIR``, or the
+  legacy ``BENCH_PROFILE`` spelling bench.py shipped with); a no-op
+  context manager when unset, so the hook costs nothing in normal runs.
 """
 
+import contextlib
 import os
 import sys
 
@@ -33,6 +38,26 @@ def reapply_jax_platforms() -> str:
 
         jax.config.update("jax_platforms", platforms)
     return platforms
+
+
+def profile_ctx(tool: str = "bench"):
+    """The shared jax.profiler capture hook: a ``jax.profiler.trace``
+    context over ``$BENCH_PROFILE_DIR/<tool>`` when the env var is set
+    (``BENCH_PROFILE``, bench.py's original spelling, still honored —
+    its value is used as-is, no per-tool subdirectory), else a no-op
+    ``nullcontext``. Per-tool subdirectories keep a window harvest
+    that profiles BOTH drivers from clobbering one capture with the
+    other."""
+    trace_dir = os.environ.get("BENCH_PROFILE_DIR")
+    if trace_dir:
+        trace_dir = os.path.join(trace_dir, tool)
+    else:
+        trace_dir = os.environ.get("BENCH_PROFILE")
+    if trace_dir:
+        import jax
+
+        return jax.profiler.trace(trace_dir)
+    return contextlib.nullcontext()
 
 
 def strict_tpu_abort(tool: str, platform: str) -> None:

@@ -66,6 +66,14 @@ def parse_args():
                          "jitted round scans (reference tools.py:236)")
     ap.add_argument("--profile", type=str, default=None, metavar="DIR",
                     help="capture a jax.profiler trace of the run to DIR")
+    ap.add_argument("--trace_dir", type=str, default=None, metavar="DIR",
+                    help="extension (jax): emit per-round trace span "
+                         "records (utils.trace JSONL; one train_scan "
+                         "span per algorithm run + one round record "
+                         "per round, fault/defense counters attached "
+                         "as attributes) to "
+                         "DIR/exp1_{dataset}_trace.jsonl, with a "
+                         "per-stage summary printed at the end")
     ap.add_argument("--model", type=str, default="linear",
                     help="extension: any zoo member (linear | mlp64 | "
                          "mlp128x64 | conv8x16 ...) — every model is a "
@@ -286,6 +294,18 @@ def main():
         print("--profile captures a jax.profiler trace; ignored for "
               f"backend={args.backend}")
         args.profile = None
+    if args.trace_dir and args.backend != "jax":
+        # the emitters live in algorithms/core.py (jax round scans);
+        # the torch twin pins the reference loop untraced
+        print("--trace_dir records the jax round scans; ignored for "
+              f"backend={args.backend}")
+        args.trace_dir = None
+    if args.trace_dir:
+        # the process-global tracer algorithms/core.py emits into;
+        # exported (and summarized) in the finally below
+        from fedamw_tpu.utils import trace as trace_mod
+
+        trace_mod.configure()
     if args.profile:  # opt-in jax.profiler trace of the whole run
         import jax
 
@@ -302,6 +322,20 @@ def main():
 
             jax.profiler.stop_trace()
             print(f"profiler trace -> {args.profile}")
+        if args.trace_dir and _is_writer(args):
+            # same crash-robust placement as the profiler flush: the
+            # span records of a failing run are the ones you want most
+            from fedamw_tpu.utils import trace as trace_mod
+            from fedamw_tpu.utils.reporting import format_trace_summary
+
+            tracer = trace_mod.get_tracer()
+            os.makedirs(args.trace_dir, exist_ok=True)
+            tpath = os.path.join(args.trace_dir,
+                                 f"exp1_{args.dataset}_trace.jsonl")
+            n_spans = tracer.export_jsonl(tpath)
+            print(format_trace_summary(f"exp1_{args.dataset}",
+                                       tracer.records()))
+            print(f"trace ({n_spans} spans) -> {tpath}")
 
     data_ = {
         "epochs": R,

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 import warnings
 
 import jax
@@ -65,6 +66,7 @@ from ..fedcore.robust import (
     zscore_quarantine,
 )
 from ..ops.schedule import lr_schedule_array
+from ..utils.trace import get_tracer
 from .common import FedSetup, result_tuple
 
 # Introspection hook: the most recent jitted round trainer _round_based
@@ -1057,9 +1059,14 @@ def _round_based(
             if getattr(ma, k, None) is not None
         }
 
+    # host-timed around the one fused scan dispatch (utils.trace): the
+    # np.asarray fetch blocks until the device finishes, so the window
+    # covers dispatch + compute + transfer — what a round actually cost
+    t_scan0 = time.perf_counter()
     metrics, fparams, fp, fopt = train(*args)
 
     metrics = {k: np.asarray(v) for k, v in metrics.items()}
+    scan_s = time.perf_counter() - t_scan0
     out = result_tuple(metrics["train_loss"], metrics["test_loss"],
                        metrics["test_acc"])
     if faults_on:
@@ -1110,6 +1117,8 @@ def _round_based(
         defense["client_valid"] = (
             np.asarray(setup.sizes) > 0).astype(int)
         out["defense"] = defense
+    _emit_round_spans(out, metrics, aggregation, robust_canonical,
+                      faults_on, start_round, stop, t_scan0, scan_s)
     if return_state:
         # final global model + mixture weights + optimizer state, for
         # checkpointing (utils/checkpoint.py); optimizer state travels
@@ -1123,6 +1132,51 @@ def _round_based(
             out["server_opt"] = tuple(jax.tree.leaves(fopt))
             out["server_opt_kind"] = server_opt
     return out
+
+
+def _emit_round_spans(out, metrics, aggregation, robust_canonical,
+                      faults_on, start_round, stop, t_scan0, scan_s):
+    """Training-side trace plane (ISSUE 5): when the process-global
+    tracer is enabled (``exp.py --trace_dir`` configures it), emit one
+    ``"train_scan"`` span covering the fused dispatch plus one
+    ``"round"`` record per round, carrying the per-round metric stream
+    and the already-carried fault/defense counters as attributes.
+
+    The whole run is ONE ``lax.scan`` program, so the host cannot see
+    round boundaries — per-round duration is the scan wall-clock
+    attributed uniformly, and every round record says so
+    (``attrs["timing"] == "uniform"``); the counters and losses are
+    exact per-round data either way."""
+    tracer = get_tracer()
+    if not tracer.enabled:
+        return
+    n_r = stop - start_round
+    run_id = tracer.new_id("run")
+    scan_id = tracer.emit(
+        "train_scan", run_id, t_scan0, scan_s,
+        aggregation=aggregation, rounds=n_r, start_round=start_round,
+        robust_agg=robust_canonical, faults=bool(faults_on),
+        timing="host")
+    per = scan_s / max(1, n_r)
+    fc = out.get("fault_counts", {})
+    dfz = out.get("defense", {})
+    for i in range(n_r):
+        attrs = {
+            "round": start_round + i,
+            "train_loss": float(metrics["train_loss"][i]),
+            "test_loss": float(metrics["test_loss"][i]),
+            "test_acc": float(metrics["test_acc"][i]),
+            "timing": "uniform",
+        }
+        for k in ("dropped", "straggled", "corrupted", "lied",
+                  "quarantined"):
+            if k in fc:
+                attrs[k] = int(fc[k][i])
+        for k in ("z_quarantined", "rep_gated", "frac_clamped"):
+            if k in dfz:
+                attrs[k] = int(dfz[k][i])
+        tracer.emit("round", run_id, t_scan0 + i * per, per,
+                    parent_id=scan_id, **attrs)
 
 
 def FedAvg(

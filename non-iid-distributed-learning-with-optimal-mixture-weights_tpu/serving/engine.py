@@ -30,6 +30,7 @@ stays shape-static.
 
 from __future__ import annotations
 
+import time
 from typing import Sequence
 
 import jax
@@ -160,6 +161,12 @@ class ServingEngine:
         self._predict = jax.jit(forward, donate_argnums=donate)
         self._input_dim = input_dim
         self._shapes_seen: set = set()  # compile-count fallback basis
+        # host-timed stage split of the most recent predict() call
+        # (pad+transfer vs device dispatch), for the request-level
+        # trace plane: two perf_counter reads per call, always on.
+        # Single-consumer by design (the serving worker thread is the
+        # only reader, via pop_timings); not a synchronized counter.
+        self._timings: dict | None = None
 
     def _weight_keys(self) -> list[str]:
         # numeric layer order ("w2" before "w10"; bare "w" is layer 0)
@@ -235,6 +242,7 @@ class ServingEngine:
                    feature_dtype=feature_dtype, input_dim=input_dim)
 
     def _run(self, X: np.ndarray) -> np.ndarray:
+        t0 = time.perf_counter()
         n, d = X.shape
         b = bucket_for(n, self.buckets)
         if n < b:
@@ -246,14 +254,36 @@ class ServingEngine:
         x = (jnp.asarray(X) if self._in_spec is None
              else jax.device_put(X, self._in_spec))
         self._shapes_seen.add(X.shape)
+        t1 = time.perf_counter()
         out = self._predict(x, self.params, self.rff)
         # np.asarray blocks until ready — predict latency is honest
-        return np.asarray(out)[:n]
+        out = np.asarray(out)[:n]
+        t2 = time.perf_counter()
+        if self._timings is None:
+            self._timings = {"pad_s": 0.0, "dispatch_s": 0.0, "bucket": b}
+        # accumulate across an oversized request's max-bucket chunks
+        self._timings["pad_s"] += t1 - t0
+        self._timings["dispatch_s"] += t2 - t1
+        self._timings["bucket"] = b
+        return out
+
+    def pop_timings(self) -> dict | None:
+        """Host-timed stage split of the calls since the last pop:
+        ``{"pad_s", "dispatch_s", "bucket"}`` — pad/bucket/transfer
+        time vs the (blocking) device dispatch — or None when nothing
+        ran. Consumed by ``serving/service.py`` to attribute a
+        request's latency to a stage; popping clears, so a stale split
+        can never be double-billed to the next batch."""
+        t, self._timings = self._timings, None
+        return t
 
     def predict(self, X) -> np.ndarray:
         """Logits for a ``(n, d)`` batch or ``(d,)`` row; any ``n`` —
         oversized batches are served in max-bucket chunks."""
         X = np.asarray(X, dtype=np.float32)
+        # fresh stage split per call: an unpopped split from an earlier
+        # (untraced) call must never be billed to this one
+        self._timings = None
         single = X.ndim == 1
         if single:
             X = X[None, :]

@@ -61,8 +61,20 @@ class ServeMetrics:
     assert on: request latency, rows/requests served, shedding, queue
     pressure, and (via the engine) the compile-cache counter."""
 
+    #: Per-request pipeline stages the service records
+    #: (``service._serve_batch``): time queued before the batch formed,
+    #: coalesce+pad to the bucket, and the engine dispatch itself.
+    #: Snapshot keys are ``{stage}_p50_ms`` etc. — the per-stage
+    #: percentile families that let a tail regression localize.
+    STAGES = ("queue", "pad", "device")
+
     def __init__(self):
         self.latency = LatencyHistogram()
+        # request-level stage latencies: batch-shared stages (pad,
+        # device) record once per REQUEST in the batch, so the
+        # percentiles weight stages by the requests they delayed —
+        # comparable to the end-to-end latency histogram above
+        self.stage_latency = {s: LatencyHistogram() for s in self.STAGES}
         self._lock = threading.Lock()
         self.requests_served = 0
         self.rows_served = 0
@@ -71,6 +83,8 @@ class ServeMetrics:
         self.shed_overload = 0
         self.shed_shutdown = 0
         self.retries = 0
+        self.requests_retried = 0
+        self.max_request_retries = 0
         self.queue_depth_peak = 0
         self._t_first = None
         self._t_last = None
@@ -105,7 +119,15 @@ class ServeMetrics:
 
     def record_batch(self, n_requests: int, n_rows: int,
                      latencies: list[float],
-                     now: float | None = None) -> None:
+                     now: float | None = None,
+                     stage_seconds: dict | None = None,
+                     request_retries: list[int] | None = None) -> None:
+        """``stage_seconds``: ``{"queue": [per-request s, ...],
+        "pad": s, "device": s}`` — scalar stages are batch-shared and
+        recorded once per request (see ``stage_latency``).
+        ``request_retries``: per-request transient-dispatch retry
+        counts (the batch-level aggregate already rides
+        :meth:`record_retry`)."""
         now = time.perf_counter() if now is None else now
         with self._lock:
             self.batches += 1
@@ -114,8 +136,22 @@ class ServeMetrics:
             if self._t_first is None:
                 self._t_first = now
             self._t_last = now
+            if request_retries:
+                self.requests_retried += sum(1 for r in request_retries
+                                             if r > 0)
+                self.max_request_retries = max(self.max_request_retries,
+                                               *request_retries)
         for s in latencies:
             self.latency.record(s)
+        if stage_seconds:
+            for stage, val in stage_seconds.items():
+                hist = self.stage_latency[stage]
+                if isinstance(val, (list, tuple)):
+                    for v in val:
+                        hist.record(v)
+                else:
+                    for _ in range(n_requests):
+                        hist.record(val)
 
     def snapshot(self, engine=None) -> dict:
         with self._lock:
@@ -131,6 +167,8 @@ class ServeMetrics:
                 "shed_overload": self.shed_overload,
                 "shed_shutdown": self.shed_shutdown,
                 "retries": self.retries,
+                "requests_retried": self.requests_retried,
+                "max_request_retries": self.max_request_retries,
                 "queue_depth_peak": self.queue_depth_peak,
                 "mean_batch_rows": (
                     round(self.rows_served / self.batches, 2)
@@ -143,6 +181,13 @@ class ServeMetrics:
                     if elapsed else None),
             }
         snap.update(self.latency.percentiles())
+        # per-stage percentile families (queue_p50_ms, pad_p95_ms,
+        # device_p99_ms, ...): the request-level tracing ISSUE — a tail
+        # regression in the end-to-end percentiles localizes to the
+        # stage whose family moved with it
+        for stage, hist in self.stage_latency.items():
+            snap.update({f"{stage}_{k}": v
+                         for k, v in hist.percentiles().items()})
         if engine is not None:
             snap["compile_count"] = engine.compile_count
         return snap

@@ -27,6 +27,7 @@ from concurrent.futures import Future, InvalidStateError
 
 import numpy as np
 
+from ..utils.trace import NULL_TRACER
 from .batcher import coalesce, drain, request_rows, split_results
 from .metrics import ServeMetrics
 
@@ -90,6 +91,8 @@ class _Request:
     future: Future
     t_submit: float
     deadline: float | None  # absolute perf_counter time, or None
+    id: str = ""  # request id assigned at submit; rides the whole path
+    retries: int = 0  # transient engine-dispatch retries this request saw
 
 
 class ServingService:
@@ -102,7 +105,8 @@ class ServingService:
 
     def __init__(self, engine, max_queue: int = 1024,
                  max_wait_ms: float = 2.0, metrics: ServeMetrics | None = None,
-                 retries: int = 2, retry_backoff_ms: float = 5.0):
+                 retries: int = 2, retry_backoff_ms: float = 5.0,
+                 tracer=None):
         """``retries``/``retry_backoff_ms``: bounded exponential-backoff
         retry of TRANSIENT engine-dispatch failures (``_is_transient``;
         a flapping remote-accelerator tunnel) — at most ``retries``
@@ -110,8 +114,19 @@ class ServingService:
         ``retry_backoff_ms`` but never sleeping past the earliest live
         deadline in the batch. Permanent errors (bad shapes, real
         bugs) still fail every affected future on the first attempt.
-        Retries are counted in ``metrics.snapshot()['retries']``."""
+        Retries are counted in ``metrics.snapshot()['retries']``.
+
+        ``tracer`` (``utils.trace.Tracer``): request-level tracing.
+        Every submit gets a request id regardless (exposed as the
+        returned Future's ``request_id``); with an
+        ENABLED tracer each request additionally lands exactly one
+        ``"request"`` span on resolution — outcome, queue/pad/device
+        stage split, retry count — and the PR 2 retry/deadline events
+        become ``"engine_retry"``/``"deadline_exceeded"`` annotations.
+        Default is the shared no-op tracer (zero per-request cost
+        beyond the id counter)."""
         self.engine = engine
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         self.max_queue = int(max_queue)
         self.max_wait = max_wait_ms / 1e3
         self.retries = int(retries)
@@ -128,6 +143,49 @@ class ServingService:
         self._depth_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+
+    # -- tracing ------------------------------------------------------
+    def _trace_request(self, req: _Request, outcome: str, done: float,
+                       queue_s=None, pad_s=None, device_s=None,
+                       batch_id=None, where=None) -> None:
+        """Emit the one ``"request"`` span a submitted request gets at
+        resolution — whichever path resolved it (served, deadline,
+        error, shutdown), so the exported trace holds every accepted
+        request id exactly once. Deadline outcomes additionally land a
+        ``"deadline_exceeded"`` annotation naming WHERE the request
+        expired (queued / during retries / the post-stop sweep) — the
+        PR 2 events, now attributable."""
+        if not self.tracer.enabled:
+            return
+        # lean on purpose (no per-field rounding, attrs dict handed to
+        # emit as-is): this runs once per served request, and its cost
+        # IS the trace plane's overhead the serve bench measures
+        attrs = {"outcome": outcome, "rows": request_rows(req.x),
+                 "retries": req.retries}
+        if queue_s is not None:
+            attrs["queue_ms"] = queue_s * 1e3
+        if pad_s is not None:
+            attrs["pad_ms"] = pad_s * 1e3
+        if device_s is not None:
+            attrs["device_ms"] = device_s * 1e3
+        if batch_id is not None:
+            attrs["batch"] = batch_id
+        if outcome == "deadline":
+            self.tracer.annotate("deadline_exceeded", req.id,
+                                 where=where or "queued")
+        self.tracer.emit("request", req.id, req.t_submit,
+                         done - req.t_submit, attrs=attrs)
+
+    def _engine_stage_split(self, fallback_device_s: float) -> tuple:
+        """``(pad_s, device_s)`` of the engine call that just returned:
+        the engine's own host-timed split when it exposes one
+        (``ServingEngine.pop_timings``), else the whole call billed to
+        the device stage (honest for a custom engine with no split)."""
+        pop = getattr(self.engine, "pop_timings", None)
+        timing = pop() if pop is not None else None
+        if timing:
+            return timing["pad_s"], timing["dispatch_s"]
+        return 0.0, fallback_device_s
 
     # -- lifecycle ----------------------------------------------------
     def start(self) -> "ServingService":
@@ -160,6 +218,7 @@ class ServingService:
                 with self._depth_lock:
                     self._depth -= 1
                 self.metrics.record_shed("shutdown")
+                self._trace_request(req, "shutdown", time.perf_counter())
                 _resolve(req.future,
                          exc=ServiceStopped("service stopping"))
         with self._depth_lock:
@@ -182,33 +241,47 @@ class ServingService:
                 return
             with self._depth_lock:
                 self._depth -= 1
-            expired = (req.deadline is not None
-                       and time.perf_counter() > req.deadline)
+            t_seen = time.perf_counter()
+            expired = (req.deadline is not None and t_seen > req.deadline)
             if expired:
                 # the sweep honors deadlines exactly like the worker's
                 # dequeue check — a stop() race must not turn an
                 # already-expired request into a late success
                 self.metrics.record_shed("deadline")
+                self._trace_request(req, "deadline", t_seen,
+                                    queue_s=t_seen - req.t_submit,
+                                    where="sweep")
                 _resolve(req.future,
                          exc=DeadlineExceeded("expired while queued"))
                 continue
             if not drain_queue:
                 self.metrics.record_shed("shutdown")
+                self._trace_request(req, "shutdown", t_seen,
+                                    queue_s=t_seen - req.t_submit)
                 _resolve(req.future,
                          exc=ServiceStopped("service stopped"))
                 continue
             try:
                 out = self.engine.predict(req.x)
             except Exception as e:
+                self._trace_request(req, "error", time.perf_counter(),
+                                    queue_s=t_seen - req.t_submit)
                 _resolve(req.future, exc=e)
                 continue
             done = time.perf_counter()
+            queue_s = t_seen - req.t_submit
+            pad_s, device_s = self._engine_stage_split(done - t_seen)
             # same accounting as the worker path: served is served,
             # whichever thread resolved it — and metrics before the
             # future, so a caller's post-result snapshot counts it
             self.metrics.record_batch(
                 n_requests=1, n_rows=request_rows(req.x),
-                latencies=[done - req.t_submit], now=done)
+                latencies=[done - req.t_submit], now=done,
+                stage_seconds={"queue": [queue_s], "pad": pad_s,
+                               "device": device_s},
+                request_retries=[req.retries])
+            self._trace_request(req, "ok", done, queue_s=queue_s,
+                                pad_s=pad_s, device_s=device_s)
             _resolve(req.future, result=out)
 
     def __enter__(self):
@@ -238,7 +311,11 @@ class ServingService:
         fut: Future = Future()
         req = _Request(
             x=x, future=fut, t_submit=now,
-            deadline=None if timeout_s is None else now + timeout_s)
+            deadline=None if timeout_s is None else now + timeout_s,
+            id=self.tracer.new_id("req"))
+        # the id is caller-visible: a client logging fut.request_id can
+        # join its own records against the exported trace
+        fut.request_id = req.id
         with self._depth_lock:
             # stop-check and enqueue are ATOMIC under the lock: stop()
             # flips the flag under the same lock, so a put either
@@ -298,6 +375,9 @@ class ServingService:
             for req in batch:
                 if req.deadline is not None and now > req.deadline:
                     self.metrics.record_shed("deadline")
+                    self._trace_request(req, "deadline", now,
+                                        queue_s=now - req.t_submit,
+                                        where="queued")
                     _resolve(req.future, exc=DeadlineExceeded(
                         f"queued {now - req.t_submit:.4f}s, past the "
                         "request deadline"))
@@ -305,13 +385,19 @@ class ServingService:
                     live.append(req)
             if not live:
                 continue
-            self._serve_batch(live)
+            self._serve_batch(live, t_formed=now)
 
-    def _serve_batch(self, live) -> None:
+    def _serve_batch(self, live, t_formed: float | None = None) -> None:
         """One micro-batch through the engine, with bounded-backoff
         retry of transient dispatch failures; every future in ``live``
         is resolved here (result, deadline, or error) — nothing can
-        strand, whichever way the engine fails."""
+        strand, whichever way the engine fails. ``t_formed`` (batch
+        formation time) closes each request's queue-wait stage; the
+        engine call's pad/device split and the retry count complete
+        the per-request stage attribution."""
+        if t_formed is None:
+            t_formed = time.perf_counter()
+        bid = self.tracer.new_id("batch") if self.tracer.enabled else None
         try:
             # coalesce INSIDE the guard: mixed feature widths in
             # one micro-batch raise here, and an escape would kill
@@ -319,22 +405,42 @@ class ServingService:
             X, spans = coalesce([r.x for r in live])
         except Exception as e:  # batch failure -> every caller told
             for req in live:
+                self._trace_request(req, "error", time.perf_counter(),
+                                    queue_s=t_formed - req.t_submit,
+                                    batch_id=bid)
                 _resolve(req.future, exc=e)
             return
+        coalesce_s = time.perf_counter() - t_formed
         attempt = 0
         while True:
             try:
-                outs = split_results(self.engine.predict(X), spans)
+                t_d0 = time.perf_counter()
+                raw = self.engine.predict(X)
+                predict_s = time.perf_counter() - t_d0
+                outs = split_results(raw, spans)
                 break
             except Exception as e:
                 if not _is_transient(e) or attempt >= self.retries:
                     # permanent (or out of budget): fail fast, every
                     # caller told — same contract as before retries
+                    done = time.perf_counter()
                     for req in live:
+                        self._trace_request(
+                            req, "error", done,
+                            queue_s=t_formed - req.t_submit,
+                            batch_id=bid)
                         _resolve(req.future, exc=e)
                     return
                 attempt += 1
                 self.metrics.record_retry()
+                for req in live:
+                    req.retries += 1
+                if bid is not None:
+                    # the PR 2 transient-retry event, attributable:
+                    # which batch, which attempt, what the engine threw
+                    self.tracer.annotate(
+                        "engine_retry", bid, attempt=attempt,
+                        error=type(e).__name__, n_requests=len(live))
                 delay = self.retry_backoff * (2 ** (attempt - 1))
                 now = time.perf_counter()
                 budgets = [r.deadline - now for r in live
@@ -358,6 +464,10 @@ class ServingService:
                 if expired:
                     for req in expired:
                         self.metrics.record_shed("deadline")
+                        self._trace_request(
+                            req, "deadline", now,
+                            queue_s=t_formed - req.t_submit,
+                            batch_id=bid, where="during_retries")
                         _resolve(req.future, exc=DeadlineExceeded(
                             "expired during engine-dispatch retries"))
                     live = [r for r in live
@@ -368,12 +478,22 @@ class ServingService:
                     # of a subset cannot raise
                     X, spans = coalesce([r.x for r in live])
         done = time.perf_counter()
+        pad_s, device_s = self._engine_stage_split(predict_s)
+        pad_s += coalesce_s  # host-side stacking is part of the stage
+        queue_waits = [t_formed - r.t_submit for r in live]
         # metrics BEFORE resolving futures: a caller that waits on
         # its future and then snapshots must see this batch counted
         self.metrics.record_batch(
             n_requests=len(live),
             n_rows=sum(request_rows(r.x) for r in live),
             latencies=[done - r.t_submit for r in live],
-            now=done)
+            now=done,
+            stage_seconds={"queue": queue_waits, "pad": pad_s,
+                           "device": device_s},
+            request_retries=[r.retries for r in live])
+        for req, q_s in zip(live, queue_waits):
+            self._trace_request(req, "ok", done, queue_s=q_s,
+                                pad_s=pad_s, device_s=device_s,
+                                batch_id=bid)
         for req, out in zip(live, outs):
             _resolve(req.future, result=out)

@@ -1,9 +1,21 @@
-from .reporting import Logger, check_significance, load_results, print_acc, print_time
+from .reporting import (Logger, check_significance, format_trace_summary,
+                        load_results, print_acc, print_time,
+                        trace_stage_summary)
+from .trace import (NULL_TRACER, TRACE_SCHEMA, Tracer, configure,
+                    get_tracer, read_jsonl)
 
 __all__ = [
     "Logger",
+    "NULL_TRACER",
+    "TRACE_SCHEMA",
+    "Tracer",
     "check_significance",
+    "configure",
+    "format_trace_summary",
+    "get_tracer",
     "load_results",
     "print_acc",
     "print_time",
+    "read_jsonl",
+    "trace_stage_summary",
 ]

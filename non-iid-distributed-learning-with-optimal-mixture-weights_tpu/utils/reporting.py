@@ -194,6 +194,57 @@ def format_defense_report(name: str, defense: dict) -> str:
         bits[0] + " active (no per-round telemetry for this spec)")
 
 
+def trace_stage_summary(records) -> dict:
+    """Aggregate trace span records (``utils.trace``) per stage name:
+    count, total seconds, and mean/p50/p95 milliseconds. Annotations
+    (zero-duration point events) are counted separately per name so a
+    retry storm is visible next to the stage it hit."""
+    stages: dict[str, list] = {}
+    notes: dict[str, int] = {}
+    for r in records:
+        if r.get("kind") == "annotation":
+            notes[r["name"]] = notes.get(r["name"], 0) + 1
+        else:
+            stages.setdefault(r["name"], []).append(float(r["dur_s"]))
+    out = {}
+    for name, durs in stages.items():
+        a = np.asarray(durs, dtype=float)
+        # nearest-rank percentiles, the same method
+        # serving.metrics.LatencyHistogram uses
+        p50, p95 = np.percentile(a, [50, 95], method="inverted_cdf")
+        out[name] = {
+            "count": int(a.size),
+            "total_s": round(float(a.sum()), 6),
+            "mean_ms": round(float(a.mean()) * 1e3, 4),
+            "p50_ms": round(float(p50) * 1e3, 4),
+            "p95_ms": round(float(p95) * 1e3, 4),
+        }
+    return {"stages": out, "annotations": notes}
+
+
+def format_trace_summary(label: str, records) -> str:
+    """Human-readable per-stage table for a trace (the trace-plane
+    mirror of :func:`format_fault_report`): one line per stage with
+    count / total / mean / p50 / p95, stages sorted by total cost so
+    the expensive one reads first, annotations footed below. Printed by
+    ``exp.py --trace_dir`` and ``serve_bench.py``'s traced leg."""
+    s = trace_stage_summary(records)
+    if not s["stages"] and not s["annotations"]:
+        return f"{label} trace: no spans recorded"
+    lines = [f"{label} trace ({sum(v['count'] for v in s['stages'].values())}"
+             f" spans):"]
+    width = max((len(n) for n in s["stages"]), default=0)
+    for name, st in sorted(s["stages"].items(),
+                           key=lambda kv: -kv[1]["total_s"]):
+        lines.append(
+            f"  {name:<{width}}  x{st['count']:<6d} "
+            f"total {st['total_s']:9.3f}s  mean {st['mean_ms']:9.3f}ms  "
+            f"p50 {st['p50_ms']:9.3f}ms  p95 {st['p95_ms']:9.3f}ms")
+    for name, n in sorted(s["annotations"].items()):
+        lines.append(f"  ! {name}: {n} event(s)")
+    return "\n".join(lines)
+
+
 def load_results(path: str) -> dict:
     """Load an ``exp1_{dataset}.pkl`` result dict (driver schema)."""
     with open(path, "rb") as f:

@@ -1,0 +1,249 @@
+"""Unified trace plane: monotonic-clock spans with ids, stdlib-only.
+
+One tracing core for the stack's three timing consumers (the serving
+request loop, the training round scans, and the benches), so a slow
+request or a slow round localizes to a STAGE instead of disappearing
+into one end-to-end number. Deliberately stdlib-only — a serving box
+must not grow runtime deps for its observability, same rule as
+``serving/metrics.py``.
+
+Design:
+
+- A **span** is one timed interval: ``name``, a ``trace_id`` grouping
+  every span of one request/run, its own ``span_id``, an optional
+  ``parent_id``, a monotonic ``start_s`` (``time.perf_counter`` basis —
+  durations are exact, wall-clock is deliberately absent), ``dur_s``,
+  and a flat ``attrs`` dict. A **kind** of ``"annotation"`` marks a
+  zero-duration point event (a retry, a deadline verdict) attached to
+  the same trace id.
+- :class:`Tracer` is a thread-safe bounded collector. Past
+  ``max_spans`` it DROPS new spans and counts them (``dropped``) —
+  keeping the oldest is the right degradation for request traces,
+  where the bench sizes the bound to the stream and a silent
+  ring-buffer overwrite would break the "every request id appears
+  exactly once" accounting.
+- Disabled mode is free: ``Tracer(enabled=False)`` (or the shared
+  :data:`NULL_TRACER`) makes ``emit``/``annotate`` immediate returns
+  and ``span()`` hand back one process-wide no-op context manager —
+  no per-call allocation, pinned by ``tests/test_trace.py``.
+- Export is JSONL (one span object per line, ``schema`` in a leading
+  header line) via :meth:`Tracer.export_jsonl`;
+  :func:`read_jsonl` round-trips it.
+
+The process-global tracer (:func:`configure` / :func:`get_tracer`) is
+how the training side opts in without threading a tracer through every
+algorithm signature: ``exp.py --trace_dir`` configures it, and
+``algorithms/core.py`` emits per-round records when it is enabled —
+host-timed around the one fused scan dispatch, with the per-round
+duration attributed uniformly (the scan is a single XLA program; the
+host cannot see round boundaries, and the records say so via
+``attrs["timing"] == "uniform"``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import threading
+import time
+
+#: JSONL header schema tag (bumped on incompatible record changes).
+TRACE_SCHEMA = "TRACE.v1"
+
+#: Record keys every exported span carries, in export order.
+SPAN_FIELDS = ("name", "kind", "trace_id", "span_id", "parent_id",
+               "start_s", "dur_s", "attrs")
+
+
+class _NullSpan:
+    """The shared no-op context manager disabled tracers hand out.
+
+    One process-wide instance (:data:`_NULL_SPAN`): ``span()`` on a
+    disabled tracer must not allocate per call — serving's submit path
+    runs it per request.
+    """
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _LiveSpan:
+    """Context manager recording one span on exit (success or raise)."""
+
+    __slots__ = ("_tracer", "name", "trace_id", "parent_id", "attrs",
+                 "_t0", "span_id")
+
+    def __init__(self, tracer, name, trace_id, parent_id, attrs):
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self.span_id = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        if exc_type is not None:
+            # a failed stage is the span you want most; never swallow
+            self.attrs = dict(self.attrs, error=exc_type.__name__)
+        self.span_id = self._tracer.emit(
+            self.name, self.trace_id, self._t0, dur,
+            parent_id=self.parent_id, **self.attrs)
+        return False
+
+
+class Tracer:
+    """Thread-safe bounded span collector with a free disabled mode."""
+
+    def __init__(self, enabled: bool = True, max_spans: int = 100_000):
+        if max_spans <= 0:
+            raise ValueError(f"max_spans must be positive, got {max_spans}")
+        self.enabled = bool(enabled)
+        self.max_spans = int(max_spans)
+        self._spans: list[dict] = []
+        self._dropped = 0
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+
+    # -- ids ----------------------------------------------------------
+    def new_id(self, prefix: str = "t") -> str:
+        """A fresh process-unique trace/request id (``prefix-N``).
+        Cheap and monotonic; handed out even when disabled, so callers
+        (serving's submit) never branch on tracer state for identity."""
+        return f"{prefix}-{next(self._ids)}"
+
+    # -- recording ----------------------------------------------------
+    def emit(self, name: str, trace_id: str, start_s: float,
+             dur_s: float, parent_id: str | None = None,
+             kind: str = "span", attrs: dict | None = None,
+             **kw) -> str | None:
+        """Record one completed span; returns its span id (None when
+        disabled or dropped at the bound). Attributes go in ``attrs``
+        (the caller's dict is taken as-is — the hot-path spelling; the
+        serving loop emits one span per request) or as keyword
+        arguments (the convenient spelling); both at once merge, kw
+        winning."""
+        if not self.enabled:
+            return None
+        if attrs is None:
+            attrs = kw
+        elif kw:
+            attrs = {**attrs, **kw}
+        rec = {
+            "name": name,
+            "kind": kind,
+            "trace_id": trace_id,
+            "span_id": None,  # assigned under the lock, below
+            "parent_id": parent_id,
+            "start_s": float(start_s),
+            "dur_s": float(dur_s),
+            "attrs": attrs,
+        }
+        with self._lock:
+            if len(self._spans) >= self.max_spans:
+                self._dropped += 1
+                return None
+            rec["span_id"] = f"s-{next(self._ids)}"
+            self._spans.append(rec)
+        return rec["span_id"]
+
+    def annotate(self, name: str, trace_id: str,
+                 parent_id: str | None = None, **attrs) -> str | None:
+        """A zero-duration point event (retry, deadline verdict) on an
+        existing trace — rendered alongside its spans on export."""
+        if not self.enabled:  # skip even the perf_counter call
+            return None
+        return self.emit(name, trace_id, time.perf_counter(), 0.0,
+                         parent_id=parent_id, kind="annotation", **attrs)
+
+    def span(self, name: str, trace_id: str,
+             parent_id: str | None = None, **attrs):
+        """Context manager timing its body into one span. Disabled
+        tracers return the shared no-op instance (zero allocation)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _LiveSpan(self, name, trace_id, parent_id, attrs)
+
+    # -- introspection / export ---------------------------------------
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def records(self) -> list[dict]:
+        """Snapshot copy of the collected spans, in emit order."""
+        with self._lock:
+            return [dict(r) for r in self._spans]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._dropped = 0
+
+    def export_jsonl(self, path: str) -> int:
+        """Write ``{schema header}\\n{span}\\n...``; returns the span
+        count written (header excluded)."""
+        recs = self.records()
+        with open(path, "w") as f:
+            f.write(json.dumps({"schema": TRACE_SCHEMA,
+                                "spans": len(recs),
+                                "dropped": self.dropped}) + "\n")
+            for r in recs:
+                f.write(json.dumps({k: r[k] for k in SPAN_FIELDS}) + "\n")
+        return len(recs)
+
+
+def read_jsonl(path: str) -> tuple[dict, list[dict]]:
+    """Inverse of :meth:`Tracer.export_jsonl`:
+    ``(header, spans)``. Raises ``ValueError`` on a non-trace file —
+    the header line must carry the ``TRACE.`` schema family."""
+    with open(path) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    if not lines or not str(lines[0].get("schema", "")).startswith("TRACE."):
+        raise ValueError(f"{path}: not a trace JSONL (missing "
+                         f"{TRACE_SCHEMA!r}-family header line)")
+    return lines[0], lines[1:]
+
+
+#: The shared disabled tracer: emit/annotate are immediate returns and
+#: span() is the no-op singleton. Module-level so hot paths can default
+#: to it without constructing anything.
+NULL_TRACER = Tracer(enabled=False)
+
+_global_tracer: Tracer = NULL_TRACER
+_global_lock = threading.Lock()
+
+
+def configure(enabled: bool = True, max_spans: int = 1_000_000) -> Tracer:
+    """Install (and return) the process-global tracer — how ``exp.py
+    --trace_dir`` turns on per-round training spans without threading a
+    tracer through every algorithm signature. ``configure(False)``
+    restores the free :data:`NULL_TRACER`."""
+    global _global_tracer
+    with _global_lock:
+        _global_tracer = (Tracer(enabled=True, max_spans=max_spans)
+                          if enabled else NULL_TRACER)
+        return _global_tracer
+
+
+def get_tracer() -> Tracer:
+    """The process-global tracer (:data:`NULL_TRACER` until
+    :func:`configure`); emitters must treat it as possibly disabled."""
+    return _global_tracer

@@ -25,12 +25,27 @@ measuring anything, so a leaked JAX_PLATFORMS=cpu can never be
 harvested green (mirrors bench.py; pinned in
 ``tests/test_serve_contract.py``).
 
+The mixed stream now runs both untraced — its snapshot, carrying
+per-stage (queue / pad / device) latency percentiles, is the headline
+source — and through a live ``utils.trace`` Tracer (ISSUE 5), which
+must hold every submitted request id exactly once (abort on violation,
+like the parity gate). The tracing cost is reported as
+``serve_trace_overhead``: best-of-``SERVE_TRACE_REPS`` (default 5)
+alternating traced/untraced legs, so a ~tens-of-ms stream's
+thread-scheduling noise does not masquerade as overhead. The artifact
+grows ``phases`` (build / compile-warmup / timed-run seconds) and a
+``trace`` section; recompiles-after-warmup is checked across ALL
+streams.
+
 Env knobs: SERVE_BUCKETS ("1,8,64,512"), SERVE_D (RFF width, 256),
 SERVE_N (train rows, 4096), SERVE_CLIENTS (8), SERVE_TRAIN_ROUNDS (2),
 SERVE_ITERS (per-bucket timed calls, 30), SERVE_REQUESTS (mixed-stream
 requests, 200), SERVE_MAX_WAIT_MS (2.0), SERVE_CKPT (serve an existing
 checkpoint dir instead of training), SERVE_OUT, SERVE_ROUND (artifact
-suffix, default 1).
+suffix, default 1), SERVE_TRACE (directory: export the traced leg's
+span records as JSONL there), BENCH_PROFILE_DIR (jax.profiler capture
+of the timed section, shared with bench.py via
+bench_common.profile_ctx).
 """
 
 import json
@@ -113,11 +128,15 @@ def time_bucket(engine, b: int, iters: int, rng) -> dict:
     return out
 
 
-def mixed_stream(engine, n_requests: int, max_wait_ms: float, rng) -> dict:
+def mixed_stream(engine, n_requests: int, max_wait_ms: float, rng,
+                 tracer=None) -> dict:
     """Drive a deterministic mixed-size request stream through the full
-    service loop and snapshot its metrics. Sizes mix single rows with
+    service loop and snapshot its metrics (now including the per-stage
+    queue/pad/device percentile families). Sizes mix single rows with
     every rung boundary's neighborhood so each compiled bucket serves
-    real (non-warmup) traffic."""
+    real (non-warmup) traffic. ``tracer``: a live ``utils.trace``
+    Tracer for the traced leg (every accepted request lands one
+    "request" span); None keeps the no-op default."""
     from fedamw_tpu.serving import ServingService
 
     sizes = []
@@ -132,7 +151,8 @@ def mixed_stream(engine, n_requests: int, max_wait_ms: float, rng) -> dict:
     # max_queue must admit the whole configured stream or a large
     # SERVE_REQUESTS would crash with Overloaded instead of measuring
     with ServingService(engine, max_wait_ms=max_wait_ms,
-                        max_queue=max(1024, n_requests)) as svc:
+                        max_queue=max(1024, n_requests),
+                        tracer=tracer) as svc:
         futures = [svc.submit(x) for x in payloads]
         for f in futures:
             f.result(timeout=300)
@@ -169,6 +189,7 @@ def main():
     ckpt = os.environ.get("SERVE_CKPT")
     setup = None
     scratch = None  # our own train-and-serve checkpoint, removed on exit
+    t_build0 = time.perf_counter()
     if ckpt:
         engine = ServingEngine.load(ckpt, buckets=buckets)
         print(f"# serving existing checkpoint {ckpt}", file=sys.stderr)
@@ -179,17 +200,18 @@ def main():
             clients=_env_int("SERVE_CLIENTS", 8),
             rounds=_env_int("SERVE_TRAIN_ROUNDS", 2))
         engine = ServingEngine.load(ckpt, buckets=buckets)
+    build_s = time.perf_counter() - t_build0
     try:
         _run_bench(engine, setup, X_test_raw if setup is not None
                    else None, ckpt, platform, iters, n_requests,
-                   max_wait_ms)
+                   max_wait_ms, build_s)
     finally:
         if scratch is not None:
             shutil.rmtree(scratch, ignore_errors=True)
 
 
 def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
-               n_requests, max_wait_ms):
+               n_requests, max_wait_ms, build_s):
 
     parity = None
     if setup is not None:
@@ -210,22 +232,94 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
           f"({len(engine.buckets)} buckets) in {warmup_s:.2f}s",
           file=sys.stderr)
 
+    from bench_common import profile_ctx
+    from fedamw_tpu.utils.reporting import format_trace_summary
+    from fedamw_tpu.utils.trace import Tracer
+
     rng = np.random.RandomState(0)
     bucket_latency = {}
-    for b in engine.buckets:
-        bucket_latency[str(b)] = rec = time_bucket(engine, b, iters, rng)
-        print(json.dumps({
-            "metric": "serve_bucket_latency",
-            "bucket": b, "platform": platform, **rec}))
-        print(f"# bucket {b:>5}: p50 {rec['p50_ms']}ms  p99 "
-              f"{rec['p99_ms']}ms  {rec['throughput_rows_per_s']} rows/s",
-              file=sys.stderr)
+    t_timed0 = time.perf_counter()
+    with profile_ctx("serve_bench"):
+        for b in engine.buckets:
+            bucket_latency[str(b)] = rec = time_bucket(engine, b, iters,
+                                                       rng)
+            print(json.dumps({
+                "metric": "serve_bucket_latency",
+                "bucket": b, "platform": platform, **rec}))
+            print(f"# bucket {b:>5}: p50 {rec['p50_ms']}ms  p99 "
+                  f"{rec['p99_ms']}ms  "
+                  f"{rec['throughput_rows_per_s']} rows/s",
+                  file=sys.stderr)
 
-    stream = mixed_stream(engine, n_requests, max_wait_ms, rng)
+        stream = mixed_stream(engine, n_requests, max_wait_ms, rng)
+
+        # traced twin of the mixed stream (ISSUE 5): the tracing cost
+        # as BEST-of-reps over PAIRED legs. Pairing matters twice:
+        # each rep reseeds its rng so the off and on leg serve the
+        # IDENTICAL request-size stream (a shared rng would hand the
+        # two legs different size mixes — a systematic bias that
+        # measured as a fake 1.6x overhead), and max-throughput over
+        # reps is the standard steady-state estimator that shrugs off
+        # the +-17% thread-scheduling noise of a ~tens-of-ms stream
+        reps = _env_int("SERVE_TRACE_REPS", 5)
+        # floor the overhead streams at 200 requests: a 40-request
+        # stream lasts ~4 ms, inside one scheduler quantum, and its
+        # timing is quantization noise whatever the estimator
+        n_overhead = max(n_requests, 200)
+        best_off, best_on = 0.0, 0.0
+        tracer, traced = None, None
+        for rep in range(max(1, reps)):
+            off_snap = mixed_stream(engine, n_overhead, max_wait_ms,
+                                    np.random.RandomState(100 + rep))
+            best_off = max(best_off, off_snap["throughput_req_per_s"])
+            t = Tracer(max_spans=4 * n_overhead + 64)
+            on_snap = mixed_stream(engine, n_overhead, max_wait_ms,
+                                   np.random.RandomState(100 + rep),
+                                   tracer=t)
+            if on_snap["throughput_req_per_s"] >= best_on:
+                # keep the WINNING rep's tracer and snapshot together,
+                # so the artifact's tracing_on_* fields (throughput,
+                # p50) and the exported trace all describe one run
+                best_on = on_snap["throughput_req_per_s"]
+                tracer, traced = t, on_snap
+    timed_s = time.perf_counter() - t_timed0
+
+    # the zero-recompile pin now spans BOTH streams: tracing must not
+    # perturb the shape discipline (host-side timestamps only)
     recompiles = engine.compile_count - warm_compiles
     print(f"# mixed stream: {stream['requests']} requests in "
-          f"{stream['batches']} batches, p50 {stream['p50_ms']}ms, "
-          f"recompiles after warmup: {recompiles}", file=sys.stderr)
+          f"{stream['batches']} batches, p50 {stream['p50_ms']}ms "
+          f"(queue p50 {stream['queue_p50_ms']}ms / pad "
+          f"{stream['pad_p50_ms']}ms / device "
+          f"{stream['device_p50_ms']}ms), recompiles after warmup "
+          f"(both streams): {recompiles}", file=sys.stderr)
+
+    req_spans = [r for r in tracer.records() if r["name"] == "request"]
+    ids = [r["trace_id"] for r in req_spans]
+    ids_unique_once = (len(ids) == n_overhead
+                       and len(set(ids)) == len(ids)
+                       and tracer.dropped == 0)
+    print(format_trace_summary("serve mixed-stream", tracer.records()),
+          file=sys.stderr)
+    if not ids_unique_once:
+        # like the parity gate: a trace that lost or duplicated a
+        # request must never emit green-looking overhead numbers
+        print(f"# serve_bench aborted: {len(ids)} request spans "
+              f"({len(set(ids))} unique, {tracer.dropped} dropped) for "
+              f"{n_overhead} submitted requests", file=sys.stderr)
+        raise SystemExit(1)
+    trace_out = None
+    if os.environ.get("SERVE_TRACE"):
+        os.makedirs(os.environ["SERVE_TRACE"], exist_ok=True)
+        trace_out = os.path.join(os.environ["SERVE_TRACE"],
+                                 "serve_trace.jsonl")
+        tracer.export_jsonl(trace_out)
+        print(f"# trace -> {trace_out}", file=sys.stderr)
+
+    overhead = best_off / best_on if best_on else float("inf")
+    print(f"# trace overhead (best of {reps} alternating reps): traced "
+          f"{best_on} req/s vs untraced {best_off} req/s "
+          f"-> {overhead:.3f}x", file=sys.stderr)
 
     artifact = {
         "metric": "serve_bench",
@@ -240,8 +334,26 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
         },
         "warmup": {"compile_count": warm_compiles,
                    "seconds": round(warmup_s, 3)},
+        "phases": {"build_s": round(build_s, 3),
+                   "compile_warmup_s": round(warmup_s, 3),
+                   "timed_run_s": round(timed_s, 3)},
         "bucket_latency": bucket_latency,
         "mixed_stream": stream,
+        "trace": {
+            "request_spans": len(req_spans),
+            "unique_request_ids": len(set(ids)),
+            "all_ids_unique_once": ids_unique_once,
+            "spans_total": len(tracer.records()),
+            "dropped": tracer.dropped,
+            "exported": trace_out,
+        },
+        "trace_overhead": {
+            "value": round(overhead, 3),
+            "reps": reps,
+            "tracing_off_req_per_s": best_off,
+            "tracing_on_req_per_s": best_on,
+            "tracing_on_p50_ms": traced["p50_ms"],
+        },
         "recompiles_after_warmup": recompiles,
         "parity": parity,
     }
@@ -251,6 +363,17 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
     with open(out_path, "w") as f:
         json.dump(artifact, f, indent=1)
     print(f"# artifact -> {out_path}", file=sys.stderr)
+
+    # the trace-plane cost line (before the headline, which stays LAST)
+    print(json.dumps({
+        "metric": "serve_trace_overhead",
+        "value": round(overhead, 3),
+        "unit": "x-vs-untraced",
+        "tracing_off_req_per_s": best_off,
+        "tracing_on_req_per_s": best_on,
+        "request_spans": len(req_spans),
+        "platform": platform,
+    }))
 
     # headline LAST (driver contract, as in bench.py): request
     # throughput through the full service path, tails attached

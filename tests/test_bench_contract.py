@@ -27,11 +27,13 @@ def test_bench_emits_driver_contract_json():
         BENCH_REF_ROUNDS="1", BENCH_AMW_REF_ROUNDS="1",
     )
     # ambient knobs that would flip the asserted defended-leg /
-    # reputation-leg shape (a developer shell may export them)
+    # reputation-leg / trace-leg shape (a developer shell may export
+    # them)
     for k in ("BENCH_NO_DEFENDED", "BENCH_DEFENDED",
               "BENCH_DEFENDED_AGG", "BENCH_DEFENDED_FAULTS",
               "BENCH_NO_REPUTATION", "BENCH_REPUTATION_AGG",
-              "BENCH_REPUTATION_FAULTS"):
+              "BENCH_REPUTATION_FAULTS", "BENCH_NO_TRACE",
+              "BENCH_TRACE_OVERHEAD"):
         env.pop(k, None)
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
@@ -39,7 +41,7 @@ def test_bench_emits_driver_contract_json():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     lines = [json.loads(ln) for ln in out.stdout.splitlines() if ln]
-    assert len(lines) == 5
+    assert len(lines) == 6
     # headline LAST (the driver records the final line), and its
     # kill-safety duplicate printed BEFORE the defended leg's runs
     assert lines[-1]["metric"] == "client_updates_per_sec"
@@ -53,6 +55,11 @@ def test_bench_emits_driver_contract_json():
         assert rec["baseline_arm"] in ("reference-loop", "torch-backend")
         # "xla", a pallas layout, or a FedAMW "kernel+psolver" pair label
         assert rec["impl"] == "xla" or rec["impl"].startswith("pallas")
+    # the headline carries the phase-attributed wall-clock of the
+    # winning leg (ISSUE 5 bench contract)
+    phases = lines[-1]["phases"]
+    for k in ("build_s", "compile_warmup_s", "timed_run_s"):
+        assert phases[k] > 0
     # the defended-round leg (ISSUE 3): fault plane + defense overhead
     # vs the faulted plain mean, on the same plan
     dfd = lines[2]
@@ -74,6 +81,18 @@ def test_bench_emits_driver_contract_json():
     assert rep["faulted_mean_updates_per_sec"] > 0
     assert "rep" in rep["robust_agg"]
     assert rep["platform"] == "cpu"
+    # the trace-plane cost leg (ISSUE 5): tracing on vs off, on the
+    # same compiled program
+    trc = lines[4]
+    assert trc["metric"] == "trace_overhead"
+    assert trc["value"] > 0
+    assert trc["unit"] == "x-vs-untraced"
+    assert trc["traced_updates_per_sec"] > 0
+    assert trc["untraced_updates_per_sec"] > 0
+    # one train_scan span + one round record per round, per traced run
+    # (warmup + timed = 2 runs of BENCH_ROUNDS=2 -> 2 * (1 + 2))
+    assert trc["spans_recorded"] == 6
+    assert trc["platform"] == "cpu"
     # driver-captured roofline fields (PERFORMANCE.md § MFU)
     assert lines[-1]["flops_per_update"] > 0
     assert lines[-1]["achieved_gflops"] > 0
@@ -100,7 +119,8 @@ def test_bench_cpu_fallback_contract():
               "BENCH_REF_ROUNDS", "BENCH_NO_PALLAS",
               "BENCH_NO_REFERENCE", "BENCH_DEFENDED",
               "BENCH_NO_DEFENDED", "BENCH_NO_REPUTATION",
-              "BENCH_REPUTATION_AGG", "BENCH_REPUTATION_FAULTS"):
+              "BENCH_REPUTATION_AGG", "BENCH_REPUTATION_FAULTS",
+              "BENCH_NO_TRACE", "BENCH_TRACE_OVERHEAD"):
         env.pop(k, None)
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
@@ -108,8 +128,10 @@ def test_bench_cpu_fallback_contract():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "reference arm skipped in CPU fallback" in out.stderr
-    # the defended leg defers to headline kill-safety in fallback
+    # the defended and trace-overhead legs defer to headline
+    # kill-safety in fallback (both opt back in via env)
     assert "defended leg skipped in CPU fallback" in out.stderr
+    assert "trace-overhead leg skipped in CPU fallback" in out.stderr
     lines = [json.loads(ln) for ln in out.stdout.splitlines() if ln]
     assert len(lines) == 4
     assert lines[0] == lines[-1]  # kill-safety duplicate of the headline
@@ -141,7 +163,8 @@ def test_bench_fallback_defended_headline_kill_safety():
               "BENCH_REF_ROUNDS", "BENCH_NO_DEFENDED",
               "BENCH_DEFENDED_AGG", "BENCH_DEFENDED_FAULTS",
               "BENCH_NO_REPUTATION", "BENCH_REPUTATION_AGG",
-              "BENCH_REPUTATION_FAULTS"):
+              "BENCH_REPUTATION_FAULTS", "BENCH_NO_TRACE",
+              "BENCH_TRACE_OVERHEAD"):
         env.pop(k, None)
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],

@@ -1,0 +1,105 @@
+"""tools/check_bench_schema.py — the artifact-contract gate (ISSUE 5).
+
+Tier-1 on purpose: the round driver parses the committed BENCH_* /
+BENCH_SERVE_* / MULTICHIP_* artifacts, and a malformed one must fail
+the suite, not surface as a null harvest rows later. Also pins the
+negative cases (the tool must actually REJECT contract violations —
+a validator that accepts everything is worse than none) and the
+no-match guard.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import check_bench_schema as cbs  # noqa: E402
+
+
+def test_every_committed_artifact_validates():
+    rc = cbs.main(["--root", REPO, "--expect-some"])
+    assert rc == 0
+
+
+def _write(tmp_path, name, obj):
+    path = tmp_path / name
+    path.write_text(json.dumps(obj))
+    return str(path)
+
+
+def test_rejects_headline_not_last(tmp_path):
+    good_head = {"metric": "client_updates_per_sec", "value": 1.0,
+                 "unit": "client-updates/s", "platform": "cpu"}
+    tail = (json.dumps(good_head) + "\n"
+            + json.dumps({"metric": "some_other_leg"}) + "\n")
+    p = _write(tmp_path, "BENCH_r09.json",
+               {"n": 9, "rc": 0, "tail": tail, "parsed": good_head})
+    errs = cbs.validate_file(p)
+    assert any("headline-metric-last" in e for e in errs)
+
+
+def test_rejects_missing_platform_on_modern_capture(tmp_path):
+    head = {"metric": "client_updates_per_sec", "value": 2.0,
+            "unit": "client-updates/s"}
+    p = _write(tmp_path, "BENCH_r09.json",
+               {"n": 9, "rc": 0, "tail": json.dumps(head),
+                "parsed": head})
+    errs = cbs.validate_file(p)
+    assert any("platform" in e for e in errs)
+    # capture 1 predates the label and is grandfathered by number
+    p1 = _write(tmp_path, "BENCH_r01x.json",
+                {"n": 1, "rc": 0, "tail": json.dumps(head),
+                 "parsed": head})
+    assert cbs.validate_file(p1) == []
+
+
+def test_rejects_green_rc_with_null_parsed_and_allows_red(tmp_path):
+    p = _write(tmp_path, "BENCH_r09.json",
+               {"n": 9, "rc": 0, "tail": "", "parsed": None})
+    assert cbs.validate_file(p)
+    p2 = _write(tmp_path, "BENCH_r10.json",
+                {"n": 10, "rc": 1, "tail": "# aborted", "parsed": None})
+    assert cbs.validate_file(p2) == []  # the honest aborted shape (r02)
+
+
+def test_rejects_serve_artifact_drift(tmp_path):
+    art = {"metric": "serve_bench", "schema": "BENCH_SERVE.v1",
+           "platform": "cpu",
+           "bucket_latency": {"1": {"p50_ms": 0.1, "p99_ms": 0.2}},
+           "mixed_stream": {"requests": 10},
+           "recompiles_after_warmup": 0}
+    p = _write(tmp_path, "BENCH_SERVE_r09.json", art)
+    assert cbs.validate_file(p) == []
+    for key, bad in (("schema", "BENCH.v1"), ("platform", ""),
+                     ("bucket_latency", {}),
+                     ("mixed_stream", {"requests": 0}),
+                     ("recompiles_after_warmup", None)):
+        broken = dict(art, **{key: bad})
+        p = _write(tmp_path, "BENCH_SERVE_r09.json", broken)
+        assert cbs.validate_file(p), f"accepted broken {key}"
+
+
+def test_rejects_multichip_ok_rc_disagreement(tmp_path):
+    p = _write(tmp_path, "MULTICHIP_r09.json",
+               {"n_devices": 8, "rc": 124, "ok": True, "tail": "OK"})
+    errs = cbs.validate_file(p)
+    assert any("disagrees" in e for e in errs)
+    p2 = _write(tmp_path, "MULTICHIP_r10.json",
+                {"n_devices": 8, "rc": 0, "ok": True,
+                 "tail": "dryrun_multichip(8): OK"})
+    assert cbs.validate_file(p2) == []
+
+
+def test_rejects_non_json_and_unknown_family(tmp_path):
+    bad = tmp_path / "BENCH_r09.json"
+    bad.write_text("{not json")
+    assert cbs.validate_file(str(bad))
+    other = _write(tmp_path, "WHATEVER_r01.json", {})
+    assert cbs.validate_file(other)
+
+
+def test_expect_some_fails_on_empty_root(tmp_path):
+    assert cbs.main(["--root", str(tmp_path), "--expect-some"]) == 1
+    assert cbs.main(["--root", str(tmp_path)]) == 0

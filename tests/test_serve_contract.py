@@ -5,10 +5,14 @@ Pins: JSON lines on stdout with the headline LAST; a BENCH_SERVE
 artifact with per-bucket p50/p95/p99 + throughput for >= 3 rungs;
 ZERO recompiles after warmup across the mixed-size stream (the
 bucket-ladder shape discipline, read from the jit compile-cache
-counter); exact serving/evaluate accuracy parity; and the strict-
-backend guard — BENCH_STRICT_TPU must abort rc=1 on a leaked CPU
-backend BEFORE measuring anything, exactly like bench.py, so a CPU
-capture can never be harvested as TPU evidence.
+counter) — a pin that now spans the TRACED streams too; exact
+serving/evaluate accuracy parity; the ISSUE 5 trace plane — per-stage
+(queue/pad/device) percentile families in the mixed-stream snapshot,
+a trace section holding every submitted request id exactly once, the
+phases breakdown, and the serve_trace_overhead line before the
+headline; and the strict-backend guard — BENCH_STRICT_TPU must abort
+rc=1 on a leaked CPU backend BEFORE measuring anything, exactly like
+bench.py, so a CPU capture can never be harvested as TPU evidence.
 """
 
 import json
@@ -26,8 +30,10 @@ _SMALL = dict(
 
 def test_serve_bench_emits_driver_contract_json(tmp_path):
     out_path = str(tmp_path / "BENCH_SERVE_test.json")
+    trace_dir = str(tmp_path / "trace")
     env = dict(os.environ)
-    env.update(JAX_PLATFORMS="cpu", SERVE_OUT=out_path, **_SMALL)
+    env.update(JAX_PLATFORMS="cpu", SERVE_OUT=out_path,
+               SERVE_TRACE=trace_dir, **_SMALL)
     env.pop("BENCH_STRICT_TPU", None)
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "serve_bench.py")],
@@ -54,6 +60,17 @@ def test_serve_bench_emits_driver_contract_json(tmp_path):
         assert rec["p50_ms"] > 0 and rec["p99_ms"] >= rec["p50_ms"]
         assert rec["throughput_rows_per_s"] > 0
 
+    # the trace-overhead line prints before the headline (which must
+    # stay LAST for the driver's final-line parse)
+    trace_lines = [l for l in lines
+                   if l["metric"] == "serve_trace_overhead"]
+    assert len(trace_lines) == 1 and trace_lines[0] == lines[-2]
+    assert trace_lines[0]["value"] > 0
+    assert trace_lines[0]["tracing_on_req_per_s"] > 0
+    # every request of the traced stream (floored at 200 for timing
+    # stability) landed exactly one span
+    assert trace_lines[0]["request_spans"] == 200
+
     # the artifact mirrors the lines and carries the parity verdict
     with open(out_path) as f:
         art = json.load(f)
@@ -66,6 +83,38 @@ def test_serve_bench_emits_driver_contract_json(tmp_path):
     assert art["mixed_stream"]["shed_deadline"] == 0
     assert art["mixed_stream"]["shed_overload"] == 0
     assert art["warmup"]["compile_count"] == 3  # one program per rung
+
+    # ISSUE 5 pins — per-stage percentile families in the snapshot:
+    # a tail regression must localize to queue vs pad vs device
+    stream = art["mixed_stream"]
+    for stage in ("queue", "pad", "device"):
+        for q in ("p50_ms", "p95_ms", "p99_ms"):
+            assert stream[f"{stage}_{q}"] >= 0
+    # the per-request retry surface (satellite of ISSUE 5): aggregate
+    # counter AND the request-level view
+    assert stream["retries"] == 0
+    assert stream["requests_retried"] == 0
+    assert stream["max_request_retries"] == 0
+    # the exported trace held every submitted request id exactly once
+    assert art["trace"]["all_ids_unique_once"] is True
+    assert art["trace"]["request_spans"] == \
+        art["trace"]["unique_request_ids"] == 200
+    assert art["trace"]["dropped"] == 0
+    # trace overhead measured, not assumed; phases attribute the
+    # bench's own wall-clock
+    assert art["trace_overhead"]["value"] > 0
+    assert art["trace_overhead"]["tracing_on_req_per_s"] > 0
+    for k in ("build_s", "compile_warmup_s", "timed_run_s"):
+        assert art["phases"][k] >= 0
+
+    # SERVE_TRACE exported the traced leg's spans as readable JSONL
+    from fedamw_tpu.utils.trace import read_jsonl
+
+    assert art["trace"]["exported"] == os.path.join(
+        trace_dir, "serve_trace.jsonl")
+    header, spans = read_jsonl(art["trace"]["exported"])
+    req_ids = [s["trace_id"] for s in spans if s["name"] == "request"]
+    assert len(req_ids) == len(set(req_ids)) == 200
 
 
 def test_serve_strict_tpu_refuses_cpu_backend(tmp_path):

@@ -1,0 +1,281 @@
+"""The trace core (utils/trace.py) and its wiring (ISSUE 5).
+
+Pins: span lifecycle (context manager, explicit emit, annotations,
+parenting); the disabled-mode zero-allocation path (span() returns ONE
+shared no-op object and emit records nothing); the JSONL schema
+round-trip (export -> read_jsonl is lossless for every SPAN_FIELDS
+key); thread-safety under concurrent emitters AND under concurrent
+ServingService.submit (every request id lands exactly one "request"
+span, the serving-side acceptance contract); the bounded collector's
+drop accounting; and the training-side emission — a FedAvg run with
+the global tracer configured emits one train_scan span plus one round
+record per round with the fault counters attached as attributes.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from fedamw_tpu.utils import reporting
+from fedamw_tpu.utils import trace as trace_mod
+from fedamw_tpu.utils.trace import (NULL_TRACER, SPAN_FIELDS,
+                                    TRACE_SCHEMA, Tracer, read_jsonl)
+
+
+# -- span lifecycle ---------------------------------------------------
+
+def test_span_context_manager_records_duration_and_attrs():
+    tr = Tracer()
+    with tr.span("stage", "req-1", color="blue") as sp:
+        pass
+    assert sp.span_id is not None
+    (rec,) = tr.records()
+    assert rec["name"] == "stage"
+    assert rec["kind"] == "span"
+    assert rec["trace_id"] == "req-1"
+    assert rec["span_id"] == sp.span_id
+    assert rec["dur_s"] >= 0
+    assert rec["attrs"] == {"color": "blue"}
+
+
+def test_span_records_on_exception_and_reraises():
+    tr = Tracer()
+    with pytest.raises(ValueError):
+        with tr.span("failing", "req-1"):
+            raise ValueError("boom")
+    (rec,) = tr.records()
+    assert rec["attrs"]["error"] == "ValueError"
+
+
+def test_emit_parenting_and_annotations():
+    tr = Tracer()
+    parent = tr.emit("train_scan", "run-1", 0.0, 2.0, rounds=2)
+    tr.emit("round", "run-1", 0.0, 1.0, parent_id=parent, round=0)
+    tr.annotate("retry", "run-1", parent_id=parent, attempt=1)
+    scan, rnd, note = tr.records()
+    assert rnd["parent_id"] == scan["span_id"] == parent
+    assert note["kind"] == "annotation" and note["dur_s"] == 0.0
+    assert note["attrs"] == {"attempt": 1}
+
+
+def test_emit_attrs_dict_and_kwargs_spellings_merge():
+    tr = Tracer()
+    tr.emit("s", "t", 0.0, 1.0, attrs={"a": 1, "b": 1}, b=2)
+    (rec,) = tr.records()
+    assert rec["attrs"] == {"a": 1, "b": 2}  # kw wins on clash
+
+
+def test_new_ids_are_unique_and_prefixed():
+    tr = Tracer()
+    ids = [tr.new_id("req") for _ in range(100)]
+    assert len(set(ids)) == 100
+    assert all(i.startswith("req-") for i in ids)
+
+
+# -- disabled mode ----------------------------------------------------
+
+def test_disabled_span_is_one_shared_noop_object():
+    tr = Tracer(enabled=False)
+    spans = {id(tr.span("a", "t")) for _ in range(32)}
+    spans |= {id(NULL_TRACER.span("b", "t"))}
+    # the zero-allocation path: every call hands back the SAME object
+    assert len(spans) == 1
+    with tr.span("a", "t"):
+        pass
+    assert len(tr) == 0
+
+
+def test_disabled_emit_and_annotate_record_nothing():
+    tr = Tracer(enabled=False)
+    assert tr.emit("s", "t", 0.0, 1.0) is None
+    assert tr.annotate("n", "t") is None
+    assert tr.records() == [] and tr.dropped == 0
+
+
+# -- bounded collector ------------------------------------------------
+
+def test_collector_bound_drops_and_counts():
+    tr = Tracer(max_spans=3)
+    kept = [tr.emit("s", f"t{i}", 0.0, 1.0) for i in range(5)]
+    assert len(tr) == 3 and tr.dropped == 2
+    assert kept[3] is None and kept[4] is None  # dropped -> no id
+    with pytest.raises(ValueError):
+        Tracer(max_spans=0)
+
+
+# -- JSONL round-trip -------------------------------------------------
+
+def test_jsonl_schema_round_trip(tmp_path):
+    tr = Tracer()
+    parent = tr.emit("train_scan", "run-1", 1.5, 2.5, rounds=3)
+    tr.emit("round", "run-1", 1.5, 0.5, parent_id=parent,
+            round=0, test_acc=97.5)
+    tr.annotate("retry", "run-1", attempt=2)
+    path = str(tmp_path / "trace.jsonl")
+    assert tr.export_jsonl(path) == 3
+    header, spans = read_jsonl(path)
+    assert header["schema"] == TRACE_SCHEMA
+    assert header["spans"] == 3 and header["dropped"] == 0
+    originals = tr.records()
+    assert len(spans) == len(originals)
+    for got, want in zip(spans, originals):
+        assert set(got) == set(SPAN_FIELDS)
+        for k in SPAN_FIELDS:
+            assert got[k] == want[k], k
+    # a non-trace file is rejected loudly, not half-parsed
+    other = tmp_path / "not_trace.jsonl"
+    other.write_text(json.dumps({"schema": "BENCH_SERVE.v1"}) + "\n")
+    with pytest.raises(ValueError, match="TRACE"):
+        read_jsonl(str(other))
+
+
+# -- thread-safety ----------------------------------------------------
+
+def test_concurrent_emitters_lose_nothing():
+    tr = Tracer()
+    n_threads, per = 8, 200
+
+    def emitter(k):
+        for i in range(per):
+            tr.emit("s", f"t{k}-{i}", 0.0, 1.0)
+
+    threads = [threading.Thread(target=emitter, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    recs = tr.records()
+    assert len(recs) == n_threads * per
+    span_ids = [r["span_id"] for r in recs]
+    assert len(set(span_ids)) == len(span_ids)
+
+
+def test_concurrent_service_submit_traces_each_request_once():
+    """The serving-side acceptance contract: under concurrent submit
+    from many threads, every accepted request id appears EXACTLY once
+    as a "request" span in the trace."""
+    from fedamw_tpu.serving import ServingEngine, ServingService
+
+    rng = np.random.RandomState(3)
+    engine = ServingEngine({"w": rng.randn(2, 16).astype(np.float32)},
+                           buckets=(8, 64))
+    engine.warmup()
+    tr = Tracer()
+    n_threads, per = 6, 10
+    submitted: list = []
+    lock = threading.Lock()
+    with ServingService(engine, max_wait_ms=1.0, tracer=tr) as svc:
+        def client(k):
+            rng_k = np.random.RandomState(k)
+            for _ in range(per):
+                fut = svc.submit(
+                    rng_k.randn(2, 16).astype(np.float32))
+                with lock:
+                    submitted.append(fut.request_id)
+                fut.result(timeout=30)
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    req_spans = [r for r in tr.records() if r["name"] == "request"]
+    ids = [r["trace_id"] for r in req_spans]
+    assert sorted(ids) == sorted(submitted)
+    assert len(set(ids)) == len(ids) == n_threads * per
+    assert all(r["attrs"]["outcome"] == "ok" for r in req_spans)
+    # the stage split is present on every served request
+    for r in req_spans:
+        for k in ("queue_ms", "pad_ms", "device_ms"):
+            assert r["attrs"][k] >= 0
+
+
+# -- reporting --------------------------------------------------------
+
+def test_trace_summary_aggregates_per_stage():
+    tr = Tracer()
+    for d in (0.010, 0.020, 0.030):
+        tr.emit("queue", "r", 0.0, d)
+    tr.emit("device", "r", 0.0, 0.5)
+    tr.annotate("retry", "r")
+    s = reporting.trace_stage_summary(tr.records())
+    assert s["stages"]["queue"]["count"] == 3
+    assert s["stages"]["queue"]["p50_ms"] == pytest.approx(20.0)
+    assert s["stages"]["device"]["total_s"] == pytest.approx(0.5)
+    assert s["annotations"] == {"retry": 1}
+    text = reporting.format_trace_summary("unit", tr.records())
+    assert "device" in text and "! retry: 1" in text
+    # device is the costliest stage -> reads first
+    assert text.index("device") < text.index("queue")
+    assert reporting.format_trace_summary("empty", []).endswith(
+        "no spans recorded")
+
+
+# -- global tracer + training-side emission ---------------------------
+
+def test_configure_swaps_global_tracer():
+    assert trace_mod.get_tracer() is NULL_TRACER
+    try:
+        tr = trace_mod.configure()
+        assert trace_mod.get_tracer() is tr and tr.enabled
+    finally:
+        trace_mod.configure(enabled=False)
+    assert trace_mod.get_tracer() is NULL_TRACER
+
+
+def test_round_based_emits_scan_and_round_spans():
+    """algorithms.core._round_based: with the global tracer enabled, a
+    faulted FedAvg run emits one host-timed train_scan span plus one
+    round record per round, parented to it, carrying the per-round
+    metric stream and the fault counters as attributes."""
+    from fedamw_tpu.algorithms import FedAvg, prepare_setup
+    from fedamw_tpu.data import FederatedDataset, dirichlet_partition
+    from fedamw_tpu.data.synthetic import synthetic_classification
+
+    X, y, Xt, yt = synthetic_classification(256, 16, 2, seed=0)
+    parts, _ = dirichlet_partition(y, 4, alpha=0.5, seed=1, min_size=0)
+    ds = FederatedDataset(
+        name="trace-synth", task_type="classification", num_classes=2,
+        d=16, X_train=X, y_train=y, X_test=Xt, y_test=yt, parts=parts,
+        source="synthetic")
+    setup = prepare_setup(ds, D=32, kernel_par=0.1, seed=0,
+                          rng=np.random.RandomState(0))
+    rounds = 3
+    try:
+        tr = trace_mod.configure()
+        res = FedAvg(setup, lr=0.5, epoch=1, batch_size=32,
+                     round=rounds, seed=0, lr_mode="constant",
+                     faults="drop=0.5,seed=3")
+    finally:
+        trace_mod.configure(enabled=False)
+    recs = tr.records()
+    scans = [r for r in recs if r["name"] == "train_scan"]
+    rnds = [r for r in recs if r["name"] == "round"]
+    assert len(scans) == 1 and len(rnds) == rounds
+    scan = scans[0]
+    assert scan["attrs"]["rounds"] == rounds
+    assert scan["attrs"]["faults"] is True
+    assert scan["dur_s"] > 0
+    total_dropped = 0
+    for i, r in enumerate(rnds):
+        assert r["parent_id"] == scan["span_id"]
+        assert r["trace_id"] == scan["trace_id"]
+        assert r["attrs"]["round"] == i
+        assert r["attrs"]["timing"] == "uniform"  # fused scan: no
+        # host-visible round boundary, and the record says so
+        assert r["attrs"]["test_acc"] == pytest.approx(
+            float(res["test_acc"][i]))
+        total_dropped += r["attrs"]["dropped"]
+    assert total_dropped == int(
+        np.asarray(res["fault_counts"]["dropped"]).sum())
+
+
+def test_round_based_untraced_emits_nothing():
+    """The default path stays span-free (the global tracer is the
+    NULL tracer unless exp.py --trace_dir configured it)."""
+    assert trace_mod.get_tracer() is NULL_TRACER
+    assert len(NULL_TRACER) == 0

@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Validate committed bench artifacts against the driver contract.
+
+Run as a tier-1 test (``tests/test_check_bench_schema.py``) and
+standalone (``python tools/check_bench_schema.py [--root DIR]``), so a
+malformed ``BENCH_*.json`` / ``BENCH_SERVE_*.json`` / ``MULTICHIP_*.json``
+can never land silently — the round driver parses these files, and a
+key drift would only surface as a null harvest rows later.
+
+Three artifact families, three rule sets:
+
+- ``BENCH_rNN.json`` — the DRIVER-side wrapper around a ``bench.py``
+  run: ``{n, cmd, rc, tail, parsed}``. On success (``rc == 0``)
+  ``parsed`` must be the headline record (metric/value/unit present;
+  value > 0) and the LAST JSON line in ``tail`` must carry the same
+  metric — the headline-metric-LAST contract the driver parses by. On
+  failure ``parsed`` may be null (the honest shape of an aborted
+  capture, e.g. the r02 tunnel outage). The ``platform`` label is
+  required from capture 2 on (r01 predates the label; grandfathered
+  explicitly rather than loosening the rule for new artifacts).
+- ``BENCH_SERVE_rNN.json`` — ``serve_bench.py``'s own artifact:
+  ``schema`` in the ``BENCH_SERVE.`` family, a top-level ``platform``
+  label, a non-empty per-bucket latency table, a mixed-stream section
+  with a positive request count, and the ``recompiles_after_warmup``
+  field the zero-recompile pin reads.
+- ``MULTICHIP_rNN.json`` — the dryrun wrapper: ``n_devices``/``rc``/
+  ``ok``/``tail``, with ``ok`` true iff ``rc == 0`` (a disagreeing
+  pair is exactly the silent-green failure this tool exists to catch).
+
+Exit status: 0 when every matched artifact validates, 1 otherwise
+(problems listed one per line on stderr). No matches is an ERROR under
+``--expect-some`` (the tier-1 invocation: the committed artifacts
+exist, so finding none means the glob or cwd is wrong).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+#: Filename prefix -> validator. Order matters: BENCH_SERVE_ must be
+#: tested before the BENCH_ prefix it also matches.
+FAMILIES = ("BENCH_SERVE_", "BENCH_", "MULTICHIP_")
+
+
+def _tail_json_lines(tail: str) -> list[dict]:
+    out = []
+    for ln in tail.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{") and ln.endswith("}"):
+            try:
+                out.append(json.loads(ln))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def check_bench_wrapper(art: dict, name: str) -> list[str]:
+    """The driver wrapper around a bench.py run."""
+    errs = []
+    for key in ("rc", "tail"):
+        if key not in art:
+            errs.append(f"missing required field {key!r}")
+    if "parsed" not in art:
+        errs.append("missing required field 'parsed'")
+        return errs
+    parsed, rc = art["parsed"], art.get("rc")
+    if rc == 0:
+        if not isinstance(parsed, dict):
+            errs.append("rc == 0 but 'parsed' is not the headline "
+                        "record (driver failed to parse the final "
+                        "JSON line?)")
+            return errs
+        for key in ("metric", "value", "unit"):
+            if key not in parsed:
+                errs.append(f"parsed headline missing {key!r}")
+        if not isinstance(parsed.get("value"), (int, float)) \
+                or parsed.get("value", 0) <= 0:
+            errs.append(f"parsed headline value must be a positive "
+                        f"number, got {parsed.get('value')!r}")
+        # the platform label shipped with capture 2; r01 predates it
+        # and is grandfathered BY NUMBER so the rule stays strict for
+        # every artifact that could carry it
+        legacy = art.get("n") == 1
+        if "platform" not in parsed and not legacy:
+            errs.append("parsed headline missing 'platform' label "
+                        "(required from capture 2 on)")
+        # headline-metric-LAST: the driver records the final JSON line
+        lines = _tail_json_lines(art.get("tail", ""))
+        if lines and lines[-1].get("metric") != parsed.get("metric"):
+            errs.append(
+                f"headline-metric-last violated: tail's final JSON "
+                f"line is {lines[-1].get('metric')!r}, parsed is "
+                f"{parsed.get('metric')!r}")
+    elif parsed is not None and not isinstance(parsed, dict):
+        errs.append(f"rc != 0: 'parsed' must be null or a record, "
+                    f"got {type(parsed).__name__}")
+    return errs
+
+
+def check_serve_artifact(art: dict, name: str) -> list[str]:
+    """serve_bench.py's own BENCH_SERVE.vN artifact."""
+    errs = []
+    schema = str(art.get("schema", ""))
+    if not schema.startswith("BENCH_SERVE."):
+        errs.append(f"schema must be in the BENCH_SERVE. family, "
+                    f"got {art.get('schema')!r}")
+    if "metric" not in art:
+        errs.append("missing required field 'metric'")
+    if not isinstance(art.get("platform"), str) or not art["platform"]:
+        errs.append("missing top-level 'platform' label")
+    buckets = art.get("bucket_latency")
+    if not isinstance(buckets, dict) or not buckets:
+        errs.append("'bucket_latency' must be a non-empty per-rung "
+                    "table")
+    else:
+        for rung, rec in buckets.items():
+            for q in ("p50_ms", "p99_ms"):
+                if not isinstance(rec.get(q), (int, float)):
+                    errs.append(f"bucket {rung}: missing {q}")
+    stream = art.get("mixed_stream")
+    if not isinstance(stream, dict) \
+            or not isinstance(stream.get("requests"), int) \
+            or stream["requests"] <= 0:
+        errs.append("'mixed_stream' must record a positive request "
+                    "count")
+    if not isinstance(art.get("recompiles_after_warmup"), int):
+        errs.append("missing 'recompiles_after_warmup' (the "
+                    "zero-recompile pin reads it)")
+    return errs
+
+
+def check_multichip(art: dict, name: str) -> list[str]:
+    """The dryrun_multichip wrapper."""
+    errs = []
+    for key in ("n_devices", "rc", "ok", "tail"):
+        if key not in art:
+            errs.append(f"missing required field {key!r}")
+    if "rc" in art and "ok" in art and art["ok"] != (art["rc"] == 0):
+        errs.append(f"ok={art['ok']!r} disagrees with rc={art['rc']!r} "
+                    "(silent-green hazard)")
+    if art.get("ok") and "OK" not in art.get("tail", ""):
+        errs.append("ok == true but the tail carries no 'OK' verdict "
+                    "line")
+    return errs
+
+
+CHECKERS = {
+    "BENCH_SERVE_": check_serve_artifact,
+    "BENCH_": check_bench_wrapper,
+    "MULTICHIP_": check_multichip,
+}
+
+
+def validate_file(path: str) -> list[str]:
+    """All contract violations for one artifact (empty == valid)."""
+    name = os.path.basename(path)
+    try:
+        with open(path) as f:
+            art = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"unreadable/not JSON: {e}"]
+    if not isinstance(art, dict):
+        return [f"top level must be an object, got "
+                f"{type(art).__name__}"]
+    for prefix in FAMILIES:
+        if name.startswith(prefix):
+            return CHECKERS[prefix](art, name)
+    return [f"no schema family matches {name!r}"]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate committed bench artifacts against the "
+                    "driver contract")
+    ap.add_argument("paths", nargs="*",
+                    help="artifact files to check (default: every "
+                         "BENCH_*/BENCH_SERVE_*/MULTICHIP_* JSON under "
+                         "--root)")
+    ap.add_argument("--root", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="repo root to glob when no paths are given")
+    ap.add_argument("--expect-some", action="store_true",
+                    help="fail when no artifact matches (the tier-1 "
+                         "invocation: committed artifacts exist)")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or sorted(
+        p for prefix in FAMILIES
+        for p in glob.glob(os.path.join(args.root, f"{prefix}*.json")))
+    # the glob above matches BENCH_SERVE twice (its own prefix and the
+    # BENCH_ one); validate each file once
+    paths = sorted(set(paths))
+    if not paths:
+        if args.expect_some:
+            print("check_bench_schema: no artifacts matched "
+                  f"(root={args.root!r})", file=sys.stderr)
+            return 1
+        print("check_bench_schema: nothing to check")
+        return 0
+    bad = 0
+    for path in paths:
+        errs = validate_file(path)
+        if errs:
+            bad += 1
+            for e in errs:
+                print(f"{os.path.basename(path)}: {e}", file=sys.stderr)
+        else:
+            print(f"{os.path.basename(path)}: OK")
+    if bad:
+        print(f"check_bench_schema: {bad}/{len(paths)} artifact(s) "
+              "violate the driver contract", file=sys.stderr)
+        return 1
+    print(f"check_bench_schema: {len(paths)} artifact(s) OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
