@@ -144,6 +144,20 @@ def parse_args():
                          "global params + mixture weights under DIR "
                          "(orbax when available; the reference persists "
                          "metrics only)")
+    ap.add_argument("--publish_every", type=int, default=0, metavar="N",
+                    help="extension (jax; requires --save_models): run "
+                         "the round-based algorithms in N-round "
+                         "segments and publish a model checkpoint at "
+                         "every boundary (DIR/{dataset}_{algo}_repeatT/"
+                         "vNNNN) — the train side of the online "
+                         "train->serve loop: each version is "
+                         "ingestible by serving.ModelRegistry."
+                         "publish_checkpoint and hot-swappable into a "
+                         "live ServingEngine with zero recompiles. "
+                         "Segments resume exactly (params + optimizer "
+                         "state), so the stitched metrics equal the "
+                         "uninterrupted run; each extra segment costs "
+                         "one extra scan compile")
     ap.add_argument("--lr", type=float, default=None,
                     help="extension: override the registry learning "
                          "rate (config.py pins the reference's "
@@ -219,6 +233,32 @@ def parse_args():
     if args.feature_dtype is not None and args.backend != "jax":
         ap.error("--feature_dtype is a jax-backend extension (the "
                  "torch twin keeps the reference's float32 features)")
+    if args.publish_every:
+        if args.publish_every < 0:
+            ap.error(f"--publish_every must be >= 0, got "
+                     f"{args.publish_every}")
+        if args.backend != "jax":
+            ap.error("--publish_every is a jax-backend extension "
+                     "(segmented scans resume through the jax "
+                     "checkpoint path)")
+        if not args.save_models:
+            ap.error("--publish_every needs --save_models DIR: the "
+                     "published versions ARE checkpoints under it")
+        if args.multihost:
+            ap.error("--publish_every is single-host for now (the "
+                     "publisher is the serving loop's feeder; "
+                     "multihost runs write checkpoints once at the "
+                     "end)")
+        if args.faults is not None or args.robust_agg != "mean":
+            ap.error("--publish_every currently composes with the "
+                     "clean path only: the per-round fault/defense "
+                     "telemetry is not stitched across segments yet "
+                     "(use --resume for preemption durability of "
+                     "defended runs)")
+        if args.resume:
+            ap.error("--publish_every and --resume do not compose: "
+                     "segmented runs already checkpoint every N "
+                     "rounds; resume from the newest vNNNN instead")
     if args.multihost:
         if args.backend != "jax":
             ap.error("--multihost requires --backend jax")
@@ -535,6 +575,73 @@ def _resume_start(args, partial_path, train_mat, error_mat, acc_mat,
     return start_repeat
 
 
+def _ckpt_extra(res) -> dict:
+    """The checkpoint ``extra`` dict for one round-based result: the
+    optimizer-state leaves that make resume exact, plus the final
+    evaluation accuracy the serving rollout parity gate checks against
+    — ONE definition, shared by the per-boundary publisher and the
+    final --save_models write (drift between copies would produce
+    checkpoints that resume exactly from one path but not the other)."""
+    extra = {k: res[k] for k in ("p_opt", "server_opt",
+                                 "server_opt_kind") if k in res}
+    extra["eval_acc"] = float(np.asarray(res["test_acc"])[-1])
+    return extra
+
+
+def _run_segmented(algo_fn, name, setup, publish_every, R, rff,
+                   feat_dtype, save_dir, dataset, repeat, **kwargs):
+    """``--publish_every``: one round-based algorithm as a PUBLISHING
+    round loop — N-round scan segments, a model checkpoint at every
+    boundary (``DIR/{dataset}_{name}_repeat{T}/vNNNN``). Each version
+    is self-contained for serving (params + RFF draw + the round index
+    and final-round eval accuracy the rollout parity gate checks
+    against) and ingestible by ``serving.ModelRegistry.
+    publish_checkpoint``. Segment k resumes exactly from segment
+    k-1's returned state (params, mixture weights, optimizer state),
+    and every per-round stream is generated for the full horizon and
+    sliced, so the stitched metrics ARE the uninterrupted run's
+    (tests/test_checkpoint.py pins prefix+resume == full; the
+    segmented equality is pinned in tests/test_drivers.py)."""
+    from fedamw_tpu.utils.checkpoint import save_checkpoint
+
+    kwargs = dict(kwargs)
+    kwargs.pop("round", None)
+    kwargs.pop("return_state", None)
+    base = os.path.join(save_dir, f"{dataset}_{name}_repeat{repeat}")
+    state = None
+    chunks = []
+    res = None
+    for k0 in range(0, R, publish_every):
+        k1 = min(R, k0 + publish_every)
+        res = algo_fn(setup, round=R, start_round=k0, stop_round=k1,
+                      resume_from=state, return_state=True, **kwargs)
+        # keep only the per-round metric streams per segment: holding
+        # every segment's full result (params + optimizer leaves)
+        # would cost O(segments x model size) host memory for data
+        # whose only use is the concatenation below
+        chunks.append({k: np.asarray(res[k]) for k in
+                       ("train_loss", "test_loss", "test_acc")})
+        state = {k: res[k] for k in ("params", "p", "p_opt",
+                                     "server_opt", "server_opt_kind",
+                                     "reputation") if k in res}
+        final_path = os.path.join(base, f"v{k1:04d}")
+        where = save_checkpoint(
+            final_path, res["params"],
+            p=res["p"], round_idx=k1, extra=_ckpt_extra(res), rff=rff,
+            feature_dtype=feat_dtype,
+            reputation=res.get("reputation"))
+        print(f"{name}: published round-{k1} model -> {where}")
+    out = dict(res)
+    for key in ("train_loss", "test_loss", "test_acc"):
+        out[key] = np.concatenate(
+            [np.asarray(c[key]) for c in chunks])
+    # where the last boundary's (== final) checkpoint lives — the
+    # caller's "already published" pointer, derived HERE so the path
+    # format has one owner
+    out["published_final"] = final_path
+    return out
+
+
 def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete,
                  start_repeat=0, partial_path=None):
     from fedamw_tpu.data import load_dataset
@@ -654,13 +761,26 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete,
                        or args.server_opt != "none" or fault_ext):
             print(f"extensions on FedAvg/FedProx: {ext} + {fault_ext}; "
                   f"FedAMW: {amw_ext} + {fault_ext}")
-        avg = algos["FedAvg"](setup, lr=lr, **ext, **fault_ext,
-                              **round_common)
-        prox = algos["FedProx"](setup, lr=lr, prox=True, mu=mu, **ext,
-                                **fault_ext, **round_common)
-        amw = algos["FedAMW"](setup, lr=lr, lambda_reg_if=True,
-                              lambda_reg=lam, lr_p=lr_p, **amw_ext,
-                              **fault_ext, **round_common)
+        if args.publish_every:
+            # the publishing round loop (argparse-guarded: jax, clean
+            # path, --save_models set): same algorithms, same kwargs,
+            # run in N-round segments with a servable checkpoint at
+            # every boundary
+            def _round_algo(fn, name, **kw):
+                return _run_segmented(
+                    fn, name, setup, args.publish_every, R,
+                    getattr(setup, "rff", None), feat_dtype,
+                    args.save_models, args.dataset, t, **kw)
+        else:
+            def _round_algo(fn, name, **kw):
+                return fn(setup, **kw)
+        avg = _round_algo(algos["FedAvg"], "FedAvg", lr=lr, **ext,
+                          **fault_ext, **round_common)
+        prox = _round_algo(algos["FedProx"], "FedProx", lr=lr, prox=True,
+                           mu=mu, **ext, **fault_ext, **round_common)
+        amw = _round_algo(algos["FedAMW"], "FedAMW", lr=lr,
+                          lambda_reg_if=True, lambda_reg=lam, lr_p=lr_p,
+                          **amw_ext, **fault_ext, **round_common)
         for name, res, row in (("FedAvg", avg, 3), ("FedProx", prox, 4),
                                ("FedAMW", amw, 5)):
             train_mat[row, :, t] = res["train_loss"]
@@ -676,27 +796,36 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete,
                     format_defense_report
 
                 print(format_defense_report(name, res["defense"]))
-            if "params" in res and _is_writer(args):
+            if "params" in res and args.publish_every and _is_writer(args):
+                # the final state IS the last published version —
+                # re-serializing it to the base dir would duplicate
+                # v{R:04d} byte for byte
+                print(f"{name}: final model already published -> "
+                      f"{res['published_final']}")
+            elif "params" in res and _is_writer(args):
                 # one writer (matches the result-pickle gate): global
                 # params/p are replicated, so process 0 has the full
                 # state, and uncoordinated same-path saves from every
                 # process would race on a shared filesystem
                 from fedamw_tpu.utils.checkpoint import save_checkpoint
 
-                extra = {k: res[k]
-                         for k in ("p_opt", "server_opt",
-                                   "server_opt_kind")
-                         if k in res}
+                # _ckpt_extra: optimizer state for exact resume + the
+                # eval_acc the serving rollout parity gate references
                 where = save_checkpoint(
                     os.path.join(args.save_models,
                                  f"{args.dataset}_{name}_repeat{t}"),
-                    res["params"], p=res["p"], round_idx=R, extra=extra,
+                    res["params"], p=res["p"], round_idx=R,
+                    extra=_ckpt_extra(res),
                     # the RFF draw makes the checkpoint self-contained
                     # for serving RAW inputs (serving.ServingEngine);
                     # the feature-dtype marker keeps serving's raw-input
                     # narrowing matched to how the head was trained
                     rff=getattr(setup, "rff", None),
                     feature_dtype=feat_dtype,
+                    # the final trust vector of a rep-defended run —
+                    # resume must not restart a quarantined attacker
+                    # at full trust
+                    reputation=res.get("reputation"),
                 )
                 print(f"{name}: checkpoint -> {where}")
         print(f"[repeat {t}] wall time {time.time() - t0:.1f}s "

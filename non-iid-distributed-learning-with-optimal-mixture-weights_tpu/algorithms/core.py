@@ -353,7 +353,8 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
         @jax.jit
         def train(seed, X, y, idx, mask, X_val, y_val,
                   X_test, y_test, lrs, p0, sizes, mu, lam,
-                  params0=None, p_opt0=None, fault_rows=None):
+                  params0=None, p_opt0=None, fault_rows=None,
+                  rep0=None):
             keys, params = prologue(seed)
             if params0 is not None:  # resume / warm start
                 params = params0
@@ -361,6 +362,12 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 jax.random.PRNGKey(seed + 1), rounds)[start_round:stop]
             p, opt_state = p0, init_opt(p0)
             dstate0 = init_defense_state()
+            if rep0 is not None and "rep" in dstate0:
+                # resume: the carried per-client reputation continues
+                # from the checkpoint instead of restarting at full
+                # trust (a quarantined attacker must not be re-trusted
+                # by a preemption)
+                dstate0["rep"] = rep0
             if p_opt0 is not None:
                 # resume: the p-optimizer momentum buffer, shipped as a
                 # flat leaf tuple (checkpoint formats don't preserve
@@ -503,7 +510,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
     @jax.jit
     def train(seed, X, y, idx, mask, X_test, y_test, lrs,
               p_fixed, sizes, mu, lam, params0=None, server_opt0=None,
-              fault_rows=None):
+              fault_rows=None, rep0=None):
         keys, params = prologue(seed)
         if params0 is not None:  # resume / warm start
             params = params0
@@ -613,8 +620,13 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
             # tuple a checkpoint carries
             opt_state0 = jax.tree.unflatten(
                 jax.tree.structure(opt_state0), list(server_opt0))
+        dstate0 = init_defense_state()
+        if rep0 is not None and "rep" in dstate0:
+            # resume: see the learned path — the reputation carry
+            # continues from the checkpoint, not from full trust
+            dstate0["rep"] = rep0
         (params, opt_state, _dstate), metrics = jax.lax.scan(
-            body, (params, opt_state0, init_defense_state()), tuple(xs)
+            body, (params, opt_state0, dstate0), tuple(xs)
         )
         return metrics, params, p_fixed, opt_state
 
@@ -1031,6 +1043,31 @@ def _round_based(
                 "uninterrupted one (save res['server_opt'] through the "
                 "checkpoint for exact resume)", stacklevel=3)
 
+    # the reputation carry resumes from the checkpoint when the spec is
+    # stateful: without this, a preempted rep-defended run would
+    # re-trust every quarantined client at the resume boundary (the
+    # ROADMAP carried follow-on). Shape-checked here, host-side — a
+    # cohort-size mismatch must fail loudly, not broadcast.
+    rep0 = None
+    if resume_from is not None and parse_robust_spec(
+            robust_agg).rep_decay is not None:
+        rep_saved = resume_from.get("reputation")
+        if rep_saved is None:
+            warnings.warn(
+                "resuming a rep-defended run from a checkpoint without "
+                "'reputation': every client restarts fully trusted, so "
+                "the resumed run only approximates the uninterrupted "
+                "one (save with return_state=True and pass "
+                "res['reputation'] through the checkpoint — exp.py "
+                "--save_models does)", stacklevel=3)
+        else:
+            rep0 = jnp.asarray(np.asarray(rep_saved), jnp.float32)
+            if rep0.shape != (setup.num_clients,):
+                raise ValueError(
+                    f"checkpoint 'reputation' has shape {rep0.shape}; "
+                    f"this run's cohort needs ({setup.num_clients},) — "
+                    "resuming across a cohort change is undefined")
+
     # the plan rows ride the dispatch like the LR schedule: sliced from
     # the full horizon, so prefix + resume replays identical faults
     fault_rows = plan.rows(start_round, stop) if faults_on else None
@@ -1038,12 +1075,12 @@ def _round_based(
         args = (seed, setup.X, setup.y, idx_tup, mask_tup,
                 setup.X_val, setup.y_val, setup.X_test, setup.y_test,
                 lrs, p0, setup.sizes, float(mu), float(lam), params0,
-                opt0, fault_rows)
+                opt0, fault_rows, rep0)
     else:
         args = (seed, setup.X, setup.y, idx_tup, mask_tup,
                 setup.X_test, setup.y_test, lrs,
                 p0, setup.sizes, float(mu), float(lam), params0, opt0,
-                fault_rows)
+                fault_rows, rep0)
 
     if analyze_memory:
         # AOT device-memory report for the WHOLE fused training program
@@ -1131,6 +1168,12 @@ def _round_based(
         elif server_opt != "none":
             out["server_opt"] = tuple(jax.tree.leaves(fopt))
             out["server_opt_kind"] = server_opt
+        if "reputation" in metrics:
+            # the FINAL per-client reputation vector (the carried
+            # defense state's last value — the trajectory's last row),
+            # checkpointable so a resumed run continues the trust
+            # state instead of restarting at full trust
+            out["reputation"] = metrics["reputation"][-1]
     return out
 
 

@@ -46,9 +46,14 @@ def get_serving() -> ModuleType:
     """The inference side of the registry: drivers obtain the serving
     subsystem the same way they obtain a training backend —
     ``registry.get_serving().ServingEngine.load(ckpt)`` — keeping the
-    one-registry surface the north star requires. JAX-only: serving is
-    the compiled-predictor path (the torch backend is a CPU parity
-    oracle, not a serving target)."""
+    one-registry surface the north star requires. The continuous-
+    deployment loop rides the same surface: ``get_serving().
+    ModelRegistry`` (versioned train->serve store) and
+    ``get_serving().RolloutController`` (shadow/A-B canary with parity
+    gate and automatic rollback) close the loop from a running
+    training round loop to live traffic. JAX-only: serving is the
+    compiled-predictor path (the torch backend is a CPU parity oracle,
+    not a serving target)."""
     from . import serving
 
     return serving

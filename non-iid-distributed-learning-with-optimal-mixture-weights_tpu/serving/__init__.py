@@ -2,17 +2,23 @@
 
 The train side of the repo ends at ``utils/checkpoint.py``; this package
 is the serve side: ``engine`` (checkpoint -> one fused jitted predictor,
-bucket-ladder compiled, mesh-replicable), ``batcher`` (dynamic
-micro-batching), ``service`` (stdlib thread+queue request loop with
-deadlines and overload shedding), ``metrics`` (latency percentiles /
-throughput / shed counters). Driven by ``serve_bench.py`` at the repo
-root, which emits ``BENCH_SERVE_*.json`` in the ``bench.py`` schema
-family with the same strict-backend guard.
+bucket-ladder compiled, mesh-replicable, with a versioned weight store
+for zero-recompile hot swaps), ``batcher`` (dynamic micro-batching),
+``service`` (stdlib thread+queue request loop with deadlines, overload
+shedding, and rollout-aware traffic splitting), ``metrics`` (latency
+percentiles / throughput / shed counters / model-version + staleness
+dimensions), ``registry`` (versioned model store closing the
+train->serve loop), ``rollout`` (shadow/A-B canary controller with
+parity gate, error budget, and automatic rollback). Driven by
+``serve_bench.py`` at the repo root, which emits ``BENCH_SERVE_*.json``
+in the ``bench.py`` schema family with the same strict-backend guard.
 """
 
-from .batcher import MicroBatcher, coalesce, drain, split_results
+from .batcher import MicroBatcher, coalesce, drain, partition, split_results
 from .engine import DEFAULT_BUCKETS, ServingEngine, bucket_for, infer_model
 from .metrics import LatencyHistogram, ServeMetrics
+from .registry import ModelRegistry, ModelVersion
+from .rollout import RolloutController, assigned_to_candidate, split_key
 from .service import (DeadlineExceeded, Overloaded, ServiceStopped,
                       ServingService)
 
@@ -21,14 +27,20 @@ __all__ = [
     "DeadlineExceeded",
     "LatencyHistogram",
     "MicroBatcher",
+    "ModelRegistry",
+    "ModelVersion",
     "Overloaded",
+    "RolloutController",
     "ServeMetrics",
     "ServiceStopped",
     "ServingEngine",
     "ServingService",
+    "assigned_to_candidate",
     "bucket_for",
     "coalesce",
     "drain",
     "infer_model",
+    "partition",
+    "split_key",
     "split_results",
 ]

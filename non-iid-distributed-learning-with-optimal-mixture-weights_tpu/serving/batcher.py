@@ -90,6 +90,20 @@ def drain(q: "queue.Queue", first, max_rows: int,
     return batch, None
 
 
+def partition(requests, predicate) -> tuple[list, list]:
+    """One-pass split of a micro-batch into ``(matching, rest)``,
+    order preserved on both sides — how the service carves the rollout
+    candidate's slice out of a batch (``predicate`` is the
+    deterministic per-request-id assignment,
+    ``rollout.assigned_to_candidate``). A request lands on exactly one
+    side; the batch is never reordered, so queue-wait attribution
+    stays per-request exact."""
+    hit, miss = [], []
+    for r in requests:
+        (hit if predicate(r) else miss).append(r)
+    return hit, miss
+
+
 class MicroBatcher:
     """Convenience wrapper: one engine dispatch for many requests."""
 

@@ -26,10 +26,26 @@ unchanged, only the sharded axis renames from ``'clients'`` to
 replicated, and the same compiled program runs on 1 chip or a pod slice.
 Buckets are rounded up to a multiple of the mesh size so every shard
 stays shape-static.
+
+**Hot weight swap** (the train->serve loop, ``serving/registry.py`` /
+``serving/rollout.py``): params and the RFF draw are jit *arguments*,
+not closure captures, so a new round's weights with the same pytree
+structure/shapes hit the already-compiled ladder — ``swap_weights``
+installs them and flips the live pointer without a single recompile
+(``compile_count`` is pinned flat across swaps under live traffic in
+``tests/test_rollout.py``). The engine can hold several versions at
+once (a rollout candidate serves THROUGH the same compiled programs);
+``predict(version=...)`` dispatches a specific one, and ``version=None``
+resolves the live version atomically AT DISPATCH TIME — a retried
+request therefore re-resolves, so it can never run against a
+half-swapped engine. Old weights free by refcount once the last
+in-flight dispatch referencing them returns; the per-call input buffer
+stays donated on TPU as before.
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Sequence
 
@@ -103,7 +119,8 @@ class ServingEngine:
 
     def __init__(self, params, model: Model | str = "auto", rff=None,
                  buckets: Sequence[int] = DEFAULT_BUCKETS, mesh=None,
-                 feature_dtype=None, input_dim: int | None = None):
+                 feature_dtype=None, input_dim: int | None = None,
+                 version: int = 0):
         self.model = infer_model(params) if model == "auto" else model
         if isinstance(self.model, str):
             from ..models import get_model
@@ -119,22 +136,24 @@ class ServingEngine:
             raise ValueError(f"bad bucket ladder {buckets!r}")
         self.buckets = tuple(ladder)
 
-        params = jax.tree.map(jnp.asarray, params)
-        if rff is not None:
-            rff = (jnp.asarray(np.asarray(rff[0])),
-                   jnp.asarray(np.asarray(rff[1])))
         if mesh is not None:
-            from ..parallel.mesh import batch_spec, replicated
+            from ..parallel.mesh import batch_spec
 
-            rep = replicated(mesh)
-            params = jax.device_put(params, rep)
-            if rff is not None:
-                rff = jax.device_put(rff, rep)
             self._in_spec = batch_spec(mesh, 2)
         else:
             self._in_spec = None
-        self.params = params
-        self.rff = rff
+        # versioned weight store: every entry serves through the SAME
+        # compiled ladder (weights are jit arguments). _weights maps
+        # version -> (params, rff); _live names the version a
+        # version=None dispatch resolves. One lock guards both — the
+        # resolve in _resolve() and the flip in swap_weights() are the
+        # atomicity the service's retry path leans on.
+        self._wlock = threading.Lock()
+        self._weights: dict[int, tuple] = {}
+        self._live = int(version)
+        self.swap_count = 0
+        self._weights[self._live] = self._prepare_weights(params, rff,
+                                                          check=False)
 
         from ..fedcore.client import _TPU_BACKENDS
 
@@ -159,7 +178,18 @@ class ServingEngine:
             return self.model.apply(params, x)
 
         self._predict = jax.jit(forward, donate_argnums=donate)
-        self._input_dim = input_dim
+        # computed ONCE: predict() checks it per dispatch, and the
+        # swap-compatibility contract pins every version to the same
+        # leaf shapes, so the value can never go stale — a per-call
+        # property walk would re-take the weight lock on the worker's
+        # hot path for an invariant
+        if input_dim is not None:
+            self._input_dim = int(input_dim)
+        elif self.rff is not None:
+            self._input_dim = int(self.rff[0].shape[0])
+        else:
+            self._input_dim = int(
+                self.params[self._weight_keys()[0]].shape[1])
         self._shapes_seen: set = set()  # compile-count fallback basis
         # host-timed stage split of the most recent predict() call
         # (pad+transfer vs device dispatch), for the request-level
@@ -168,6 +198,204 @@ class ServingEngine:
         # only reader, via pop_timings); not a synchronized counter.
         self._timings: dict | None = None
 
+    # -- versioned weight store ---------------------------------------
+    def _prepare_weights(self, params, rff, check: bool = True) -> tuple:
+        """Host pytree -> device-resident ``(params, rff)`` matching
+        the engine's placement (replicated over the mesh when one is
+        given). With ``check``, the prepared weights must be
+        swap-compatible with the installed ones — same pytree
+        structure, leaf shapes and dtypes, and the same rff-ness (the
+        jit specialized on whether an RFF draw is fused at trace time,
+        so presence is structural, not data)."""
+        params = jax.tree.map(jnp.asarray, params)
+        if rff is not None:
+            rff = (jnp.asarray(np.asarray(rff[0])),
+                   jnp.asarray(np.asarray(rff[1])))
+        if self.mesh is not None:
+            from ..parallel.mesh import replicated
+
+            rep = replicated(self.mesh)
+            params = jax.device_put(params, rep)
+            if rff is not None:
+                rff = jax.device_put(rff, rep)
+        if check:
+            ref_p, ref_r, _ = self._resolve(None)
+            if (rff is None) != (ref_r is None):
+                raise ValueError(
+                    "swap-incompatible weights: the engine was built "
+                    f"{'with' if ref_r is not None else 'without'} a "
+                    "fused RFF draw and the new version comes "
+                    f"{'without' if rff is None else 'with'} one — "
+                    "rff-ness is compiled into the predictor")
+            try:
+                bad = jax.tree.leaves(jax.tree.map(
+                    lambda new, old: (jnp.shape(new) != jnp.shape(old)
+                                      or new.dtype != old.dtype),
+                    params, ref_p))
+            except ValueError as e:
+                raise ValueError(
+                    "swap-incompatible weights: parameter pytree "
+                    f"structure differs from the serving one ({e})"
+                ) from None
+            if any(bad):
+                raise ValueError(
+                    "swap-incompatible weights: a leaf's shape or "
+                    "dtype differs from the serving version — a swap "
+                    "must reuse the compiled ladder, and these weights "
+                    "would recompile it")
+            if rff is not None and (
+                    jnp.shape(rff[0]) != jnp.shape(ref_r[0])
+                    or jnp.shape(rff[1]) != jnp.shape(ref_r[1])):
+                raise ValueError(
+                    "swap-incompatible weights: RFF draw shape differs "
+                    "from the serving version")
+        return params, rff
+
+    def _resolve(self, version: int | None) -> tuple:
+        """``(params, rff, version)`` of one installed version — the
+        LIVE one for ``version=None``, read atomically (one lock hold
+        covers pointer + weights, so a concurrent swap can never hand
+        out version k's params with version k+1's rff)."""
+        with self._wlock:
+            v = self._live if version is None else int(version)
+            try:
+                params, rff = self._weights[v]
+            except KeyError:
+                raise KeyError(
+                    f"model version {v} is not installed (have "
+                    f"{sorted(self._weights)})") from None
+            return params, rff, v
+
+    def install_weights(self, version: int, params, rff=None) -> int:
+        """Stage one more servable version WITHOUT routing traffic to
+        it — how a rollout candidate gets device-resident next to the
+        live version. Shape/structure-checked against the serving
+        weights (a mismatch raises before anything is installed, so
+        the live version is never disturbed). Re-using an installed
+        version number is refused: the live slot only changes via
+        :meth:`swap_weights`, and silently replacing a staged
+        (possibly parity-gated) version would serve unvetted weights
+        under the vetted version's identity — ``retire`` first to
+        re-stage a number."""
+        version = int(version)
+        prepared = self._prepare_weights(params, rff)
+        with self._wlock:
+            if version == self._live:
+                raise ValueError(
+                    f"version {version} is live; swap_weights is the "
+                    "only way to change the serving weights")
+            if version in self._weights:
+                raise ValueError(
+                    f"version {version} is already installed; retire "
+                    "it first (a silent overwrite would serve "
+                    "different weights under an already-vetted "
+                    "version number)")
+            self._weights[version] = prepared
+        return version
+
+    def swap_weights(self, params=None, rff=None,
+                     version: int | None = None) -> int:
+        """Make new weights live, reusing the compiled ladder — the
+        zero-recompile hot swap. Two spellings: ``swap_weights(params,
+        rff=...)`` installs-and-flips (``version`` names the new entry,
+        default live+1), and ``swap_weights(version=k)`` flips to an
+        already-installed version (a staged rollout candidate being
+        promoted). The flip itself is one pointer write under the
+        weight lock; in-flight dispatches that already resolved keep
+        their (consistent) old weights and the old version's buffers
+        free by refcount when retired."""
+        if params is None and version is None:
+            raise ValueError("swap_weights needs params or version=")
+        if params is not None:
+            prepared = self._prepare_weights(params, rff)
+            with self._wlock:
+                # auto-version past EVERY installed entry (not just
+                # live): a staged rollout candidate occupies a slot,
+                # and live+1 could silently clobber it; assigning
+                # under the same lock hold as the install+flip also
+                # keeps two concurrent auto-swaps from racing into
+                # one slot
+                v = (max(self._weights) + 1 if version is None
+                     else int(version))
+                old = self._live
+                if v == old:
+                    # retire() refuses the live slot, so "retire it
+                    # first" would be a dead-end instruction here
+                    raise ValueError(
+                        f"version {v} is live; omit version= to "
+                        "replace the serving weights under a fresh "
+                        "number")
+                if v in self._weights:
+                    # same refusal as install_weights: an explicit
+                    # number colliding with an installed (possibly
+                    # parity-gated) version must not silently replace
+                    # it under that version's identity
+                    raise ValueError(
+                        f"version {v} is already installed; retire it "
+                        "first, or omit version= to auto-assign")
+                self._weights[v] = prepared
+                self._live = v
+                self.swap_count += 1
+                # install-and-flip REPLACES the serving weights: the
+                # replaced version is retired here, so a direct
+                # swap-per-round loop holds one version on device, not
+                # every generation (in-flight dispatches that already
+                # resolved keep their local reference — buffers free
+                # when it drops). Staged versions (install_weights)
+                # are untouched; use the flip-only spelling
+                # (version=) to move between RETAINED versions.
+                self._weights.pop(old, None)
+            return v
+        v = int(version)
+        with self._wlock:
+            if v not in self._weights:
+                raise KeyError(
+                    f"model version {v} is not installed (have "
+                    f"{sorted(self._weights)})")
+            if v != self._live:
+                self._live = v
+                self.swap_count += 1
+        return v
+
+    def retire(self, version: int) -> None:
+        """Drop an installed non-live version (its device buffers free
+        once no in-flight dispatch references them). Retiring the live
+        version is refused — the engine must always have something to
+        serve — and retiring a version that is not installed raises
+        ``KeyError`` (same contract as dispatching one): a silent
+        no-op would hide a double-retire or wrong-number bug."""
+        version = int(version)
+        with self._wlock:
+            if version == self._live:
+                raise ValueError(f"version {version} is live; swap "
+                                 "first, then retire")
+            if version not in self._weights:
+                raise KeyError(
+                    f"model version {version} is not installed (have "
+                    f"{sorted(self._weights)})")
+            del self._weights[version]
+
+    @property
+    def version(self) -> int:
+        """The live version (what a ``version=None`` dispatch serves)."""
+        with self._wlock:
+            return self._live
+
+    @property
+    def versions_installed(self) -> list[int]:
+        with self._wlock:
+            return sorted(self._weights)
+
+    @property
+    def params(self):
+        """Live-version parameters (kept as a property so the
+        pre-registry single-model surface keeps working)."""
+        return self._resolve(None)[0]
+
+    @property
+    def rff(self):
+        return self._resolve(None)[1]
+
     def _weight_keys(self) -> list[str]:
         # numeric layer order ("w2" before "w10"; bare "w" is layer 0)
         return sorted((k for k in self.params if k.startswith("w")),
@@ -175,16 +403,13 @@ class ServingEngine:
 
     @property
     def input_dim(self) -> int:
-        """Raw feature width a request row must have. Inferred from the
-        RFF draw or the first weight's fan-in; models whose pytree does
-        not start with a dense layer over the raw input (conv: the 'w'
-        head sees post-conv flattened features, not pixels) must pass
-        ``input_dim=d`` explicitly at construction."""
-        if self._input_dim is not None:
-            return self._input_dim
-        if self.rff is not None:
-            return int(self.rff[0].shape[0])
-        return int(self.params[self._weight_keys()[0]].shape[1])
+        """Raw feature width a request row must have. Inferred once at
+        construction from the RFF draw or the first weight's fan-in
+        (invariant across swaps by the compatibility check); models
+        whose pytree does not start with a dense layer over the raw
+        input (conv: the 'w' head sees post-conv flattened features,
+        not pixels) must pass ``input_dim=d`` explicitly."""
+        return self._input_dim
 
     @property
     def num_classes(self) -> int:
@@ -209,7 +434,8 @@ class ServingEngine:
     def load(cls, path: str, model: Model | str = "auto",
              buckets: Sequence[int] = DEFAULT_BUCKETS, mesh=None,
              rff=None, feature_dtype=None,
-             input_dim: int | None = None) -> "ServingEngine":
+             input_dim: int | None = None,
+             version: int = 0) -> "ServingEngine":
         """Restore a ``save_checkpoint`` directory (either layout) into
         a ready engine. A checkpoint saved with ``rff=setup.rff``
         carries its feature-map draw (``rff_W``/``rff_b``) and the
@@ -217,6 +443,14 @@ class ServingEngine:
         features (or pass ``rff=(W, b)`` explicitly). For a run trained
         with ``prepare_setup(feature_dtype=...)`` pass the same dtype
         here — the checkpoint does not record it.
+
+        ``version`` seeds the engine's live version number. In a
+        rollout deployment, pass the checkpoint's REGISTRY version
+        (``registry.publish_checkpoint(path)`` first, then
+        ``load(path, version=that)``): the staleness dimension is
+        derived by registry lookup, so a seed version the registry
+        never saw reads as staleness 0 even while training publishes
+        past it.
 
         A damaged checkpoint (truncated pickle, broken orbax tree, or
         a state with no ``params``) surfaces as a
@@ -239,9 +473,12 @@ class ServingEngine:
             feature_dtype = str(state["feature_dtype"])
         return cls(state["params"], model=model, rff=rff,
                    buckets=buckets, mesh=mesh,
-                   feature_dtype=feature_dtype, input_dim=input_dim)
+                   feature_dtype=feature_dtype, input_dim=input_dim,
+                   version=version)
 
-    def _run(self, X: np.ndarray) -> np.ndarray:
+    def _run(self, X: np.ndarray, weights: tuple,
+             timings: dict) -> np.ndarray:
+        params, rff, v = weights
         t0 = time.perf_counter()
         n, d = X.shape
         b = bucket_for(n, self.buckets)
@@ -255,35 +492,51 @@ class ServingEngine:
              else jax.device_put(X, self._in_spec))
         self._shapes_seen.add(X.shape)
         t1 = time.perf_counter()
-        out = self._predict(x, self.params, self.rff)
+        out = self._predict(x, params, rff)
         # np.asarray blocks until ready — predict latency is honest
         out = np.asarray(out)[:n]
         t2 = time.perf_counter()
-        if self._timings is None:
-            self._timings = {"pad_s": 0.0, "dispatch_s": 0.0, "bucket": b}
-        # accumulate across an oversized request's max-bucket chunks
-        self._timings["pad_s"] += t1 - t0
-        self._timings["dispatch_s"] += t2 - t1
-        self._timings["bucket"] = b
+        # accumulate across an oversized request's max-bucket chunks —
+        # into the CALLER's local dict, never the shared slot mid-call
+        # (a concurrent predict mutating shared state here could crash
+        # or cross-bill; the shared slot is written once, at the end)
+        timings["pad_s"] += t1 - t0
+        timings["dispatch_s"] += t2 - t1
+        timings["bucket"] = b
+        timings["version"] = v
         return out
 
     def pop_timings(self) -> dict | None:
         """Host-timed stage split of the calls since the last pop:
-        ``{"pad_s", "dispatch_s", "bucket"}`` — pad/bucket/transfer
-        time vs the (blocking) device dispatch — or None when nothing
-        ran. Consumed by ``serving/service.py`` to attribute a
-        request's latency to a stage; popping clears, so a stale split
-        can never be double-billed to the next batch."""
+        ``{"pad_s", "dispatch_s", "bucket", "version"}`` —
+        pad/bucket/transfer time vs the (blocking) device dispatch,
+        plus WHICH model version answered — or None when nothing ran.
+        Consumed by ``serving/service.py`` to attribute a request's
+        latency to a stage (and its span to a version); popping
+        clears, so a stale split can never be double-billed to the
+        next batch."""
         t, self._timings = self._timings, None
         return t
 
-    def predict(self, X) -> np.ndarray:
+    def predict(self, X, version: int | None = None,
+                record_timings: bool = True) -> np.ndarray:
         """Logits for a ``(n, d)`` batch or ``(d,)`` row; any ``n`` —
-        oversized batches are served in max-bucket chunks."""
+        oversized batches are served in max-bucket chunks.
+        ``version`` dispatches a specific installed version (a rollout
+        candidate); None resolves the LIVE version atomically here, at
+        dispatch time — which is why a service-level retry that calls
+        ``predict`` again lands on whatever is live THEN, never on a
+        half-swapped state.
+
+        ``record_timings=False`` keeps this call out of the
+        single-consumer ``pop_timings`` slot — for out-of-band
+        dispatches on other threads (the rollout parity gate) that
+        must not bill their timing or version to the serving worker's
+        next batch."""
+        weights = self._resolve(version)
         X = np.asarray(X, dtype=np.float32)
-        # fresh stage split per call: an unpopped split from an earlier
-        # (untraced) call must never be billed to this one
-        self._timings = None
+        timings = {"pad_s": 0.0, "dispatch_s": 0.0, "bucket": 0,
+                   "version": weights[2]}
         single = X.ndim == 1
         if single:
             X = X[None, :]
@@ -292,17 +545,24 @@ class ServingEngine:
                 f"expected (n, {self.input_dim}) rows, got {X.shape}")
         top = self.buckets[-1]
         if X.shape[0] <= top:
-            out = self._run(X)
+            out = self._run(X, weights, timings)
         else:
             out = np.concatenate(
-                [self._run(X[lo:lo + top])
+                [self._run(X[lo:lo + top], weights, timings)
                  for lo in range(0, X.shape[0], top)], axis=0)
+        if record_timings:
+            # one reference assignment AFTER the call completed: the
+            # shared slot never holds a half-built split, and an
+            # earlier call's unpopped split is replaced, not extended
+            self._timings = timings
         return out[0] if single else out
 
     def warmup(self) -> int:
         """Compile every bucket (zeros input); returns the compile
         count, after which a mixed-size stream triggers none."""
         d = self.input_dim
+        weights = self._resolve(None)
+        scratch = {"pad_s": 0.0, "dispatch_s": 0.0}
         for b in self.buckets:
-            self._run(np.zeros((b, d), np.float32))
+            self._run(np.zeros((b, d), np.float32), weights, scratch)
         return self.compile_count

@@ -86,6 +86,22 @@ class ServeMetrics:
         self.requests_retried = 0
         self.max_request_retries = 0
         self.queue_depth_peak = 0
+        # rollout dimensions (ISSUE 6): which model answered, how far
+        # behind training it is, and the swap/canary counters the
+        # continuous-deployment loop reports
+        self.requests_by_version: dict = {}
+        self.model_version = None
+        self.staleness_rounds = 0
+        # live staleness source (the rollout controller installs its
+        # registry lookup here): snapshot() re-derives staleness at
+        # read time, so a service that STOPS swapping still reports
+        # itself falling behind as training publishes — the swap-time
+        # cache alone would freeze at its last value
+        self.staleness_of = None
+        self.weight_swaps = 0
+        self.shadow_requests = 0
+        self.candidate_errors = 0
+        self.rollbacks = 0
         self._t_first = None
         self._t_last = None
 
@@ -108,6 +124,35 @@ class ServeMetrics:
             else:
                 self.shed_overload += 1
 
+    def record_swap(self, version, staleness_rounds: int = 0) -> None:
+        """One hot weight swap: ``version`` is now live,
+        ``staleness_rounds`` rounds behind the newest published model
+        (0 when it IS the newest). Called by the rollout controller on
+        promote/revert — the dimension that lets an operator see the
+        service keep pace with training."""
+        with self._lock:
+            self.weight_swaps += 1
+            self.model_version = version
+            self.staleness_rounds = int(staleness_rounds)
+
+    def record_shadow(self, n_requests: int) -> None:
+        """Shadow dispatches: requests mirrored to the candidate but
+        answered from the live version (dark-launch traffic, never
+        caller-visible)."""
+        with self._lock:
+            self.shadow_requests += int(n_requests)
+
+    def record_candidate_error(self, n_requests: int = 1) -> None:
+        """Candidate dispatch failures absorbed by the live fallback
+        (ab mode) or discarded (shadow mode) — what the rollout error
+        budget counts."""
+        with self._lock:
+            self.candidate_errors += int(n_requests)
+
+    def record_rollback(self) -> None:
+        with self._lock:
+            self.rollbacks += 1
+
     def record_retry(self) -> None:
         """One transient engine-dispatch failure absorbed by the
         service's bounded-backoff retry (``service._serve_batch``).
@@ -121,18 +166,23 @@ class ServeMetrics:
                      latencies: list[float],
                      now: float | None = None,
                      stage_seconds: dict | None = None,
-                     request_retries: list[int] | None = None) -> None:
+                     request_retries: list[int] | None = None,
+                     version=None) -> None:
         """``stage_seconds``: ``{"queue": [per-request s, ...],
         "pad": s, "device": s}`` — scalar stages are batch-shared and
         recorded once per request (see ``stage_latency``).
         ``request_retries``: per-request transient-dispatch retry
         counts (the batch-level aggregate already rides
-        :meth:`record_retry`)."""
+        :meth:`record_retry`). ``version``: which model version
+        answered this batch (per-version served counts)."""
         now = time.perf_counter() if now is None else now
         with self._lock:
             self.batches += 1
             self.requests_served += n_requests
             self.rows_served += n_rows
+            if version is not None:
+                self.requests_by_version[version] = (
+                    self.requests_by_version.get(version, 0) + n_requests)
             if self._t_first is None:
                 self._t_first = now
             self._t_last = now
@@ -179,6 +229,18 @@ class ServeMetrics:
                 "throughput_rows_per_s": (
                     round(self.rows_served / elapsed, 2)
                     if elapsed else None),
+                # rollout dimensions: live version + how far behind
+                # training, swaps absorbed, canary traffic and its
+                # fallback/rollback counters, per-version served split
+                "model_version": self.model_version,
+                "staleness_rounds": self.staleness_rounds,
+                "weight_swaps": self.weight_swaps,
+                "shadow_requests": self.shadow_requests,
+                "candidate_errors": self.candidate_errors,
+                "rollbacks": self.rollbacks,
+                "requests_by_version": {
+                    str(k): v
+                    for k, v in sorted(self.requests_by_version.items())},
             }
         snap.update(self.latency.percentiles())
         # per-stage percentile families (queue_p50_ms, pad_p95_ms,
@@ -190,4 +252,15 @@ class ServeMetrics:
                          for k, v in hist.percentiles().items()})
         if engine is not None:
             snap["compile_count"] = engine.compile_count
+            if snap["model_version"] is None:
+                # no swap ever recorded: the engine's own live version
+                # is the honest default (a single-version service)
+                snap["model_version"] = getattr(engine, "version", None)
+        if self.staleness_of is not None \
+                and snap["model_version"] is not None:
+            try:
+                snap["staleness_rounds"] = int(
+                    self.staleness_of(snap["model_version"]))
+            except Exception:
+                pass  # keep the swap-time value over no value
         return snap

@@ -1,0 +1,193 @@
+"""Versioned model registry: the train->serve handoff, in process.
+
+Training produces a new global model every round (``exp.py``'s round
+loop; ``--publish_every N`` checkpoints one every N rounds) and the
+serving stack must absorb those updates under live traffic. This module
+is the middle of that loop: a thread-safe store of immutable
+``(version, params, rff, round, metadata)`` entries, fed either from
+checkpoint directories (``publish_checkpoint`` — the cross-process
+path: training writes, serving watches) or from live result dicts
+(``publish`` — the in-process path: a driver that trains and serves in
+one process, like ``serve_bench.py``'s rollout leg).
+
+Versions are monotonically increasing integers assigned at publish —
+identity, not quality: which version *serves* is the rollout
+controller's decision (``serving/rollout.py``), gated by parity and an
+error budget. The registry only answers "what exists, how old is it":
+``staleness_rounds(v)`` is how many training rounds the newest
+published entry is ahead of ``v`` — the staleness dimension
+``ServeMetrics`` and request spans report, so an operator can see not
+just *which* model answered but *how far behind training* it was.
+
+Params/rff are stored exactly as handed in (host arrays); placing them
+on device is the engine's job at ``install_weights`` time, so the
+registry itself never touches an accelerator and can be fed from a
+checkpoint-watching thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Any, Iterator
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelVersion:
+    """One immutable published model."""
+
+    version: int
+    params: Any
+    rff: tuple | None
+    round_idx: int | None
+    source: str
+    metadata: dict
+    published_at: float  # time.time() — wall-clock, operator-facing
+
+    @property
+    def eval_acc(self) -> float | None:
+        """Training-side evaluation accuracy recorded at publish (the
+        parity gate's reference: serving the same inputs must
+        reproduce it — ``engine_acc == evaluate_acc``). None when the
+        publisher recorded none; the gate then has nothing to check
+        against and reports the candidate 'unchecked'."""
+        v = self.metadata.get("eval_acc")
+        return None if v is None else float(v)
+
+
+class ModelRegistry:
+    """Thread-safe in-process version store (see module docstring)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: dict[int, ModelVersion] = {}
+        self._next = 1
+
+    # -- publishing ---------------------------------------------------
+    def publish(self, params, rff=None, round_idx: int | None = None,
+                metadata: dict | None = None,
+                source: str = "publish") -> int:
+        """Register one model; returns its assigned version number.
+
+        ``metadata['eval_acc']`` (training's evaluation accuracy on its
+        own test set) is what arms the rollout parity gate — publishers
+        that have it should record it.
+        """
+        meta = dict(metadata) if metadata else {}
+        with self._lock:
+            v = self._next
+            self._next += 1
+            self._entries[v] = ModelVersion(
+                version=v, params=params, rff=rff,
+                round_idx=None if round_idx is None else int(round_idx),
+                source=source, metadata=meta, published_at=time.time())
+        return v
+
+    def publish_checkpoint(self, path: str,
+                           metadata: dict | None = None) -> int:
+        """Publish from a ``save_checkpoint`` directory (either
+        layout) — the cross-process feed. The checkpoint's own markers
+        (RFF draw, round index, feature dtype, a persisted 'eval_acc')
+        land in the entry; explicit ``metadata`` wins on conflict.
+        Damaged checkpoints surface as ``CheckpointError`` naming the
+        path (never a half-published entry)."""
+        from ..utils.checkpoint import CheckpointError, load_checkpoint
+
+        state = load_checkpoint(path)
+        if "params" not in state:
+            raise CheckpointError(
+                path, "state has no 'params' entry (not a "
+                f"save_checkpoint layout?); found keys {sorted(state)!r}")
+        rff = None
+        if "rff_W" in state and "rff_b" in state:
+            rff = (state["rff_W"], state["rff_b"])
+        meta = {}
+        if "feature_dtype" in state:
+            meta["feature_dtype"] = str(state["feature_dtype"])
+        if state.get("eval_acc") is not None:
+            meta["eval_acc"] = float(state["eval_acc"])
+        if metadata:
+            meta.update(metadata)
+        return self.publish(
+            state["params"], rff=rff, round_idx=state.get("round"),
+            metadata=meta, source=f"checkpoint:{os.path.abspath(path)}")
+
+    # -- lookup -------------------------------------------------------
+    def get(self, version: int) -> ModelVersion:
+        with self._lock:
+            try:
+                return self._entries[version]
+            except KeyError:
+                raise KeyError(
+                    f"version {version} not in registry (have "
+                    f"{sorted(self._entries)})") from None
+
+    def latest(self) -> ModelVersion | None:
+        with self._lock:
+            if not self._entries:
+                return None
+            return self._entries[max(self._entries)]
+
+    def versions(self) -> list[int]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, version: int) -> bool:
+        with self._lock:
+            return version in self._entries
+
+    def __iter__(self) -> Iterator[ModelVersion]:
+        with self._lock:
+            snap = [self._entries[v] for v in sorted(self._entries)]
+        return iter(snap)
+
+    def staleness_rounds(self, version: int) -> int:
+        """Training rounds the newest published entry is ahead of
+        ``version`` — 0 when ``version`` IS the newest, when the
+        version is unknown to this registry, and when either side
+        carries no round index (unknown staleness must not masquerade
+        as a large one; publishers that want the dimension must stamp
+        ``round_idx``, as ``exp.py --publish_every`` and
+        ``publish_checkpoint`` do)."""
+        with self._lock:
+            entry = self._entries.get(version)
+            if entry is None or not self._entries:
+                return 0
+            newest = self._entries[max(self._entries)]
+        if entry.round_idx is not None and newest.round_idx is not None:
+            return max(0, int(newest.round_idx) - int(entry.round_idx))
+        return 0
+
+    # -- retention ----------------------------------------------------
+    def withdraw(self, version: int) -> bool:
+        """Unpublish one entry — a gate-REJECTED candidate. A rejected
+        publish left in place keeps counting toward every other
+        version's ``staleness_rounds``, reading as "the service is
+        behind" when the only newer model is one that must never
+        serve. Returns whether anything was removed."""
+        with self._lock:
+            return self._entries.pop(int(version), None) is not None
+
+    def prune(self, keep: int, protect=()) -> list[int]:
+        """Drop the oldest entries down to ``keep``, never dropping a
+        protected version (the live/candidate set a controller pins).
+        Returns the versions removed. Bounds a long-lived publisher's
+        memory the same way the rotating trace writer bounds spans."""
+        if keep < 0:
+            raise ValueError(f"keep must be >= 0, got {keep}")
+        protected = set(protect)
+        removed = []
+        with self._lock:
+            candidates = [v for v in sorted(self._entries)
+                          if v not in protected]
+            excess = len(self._entries) - int(keep)
+            for v in candidates[:max(0, excess)]:
+                del self._entries[v]
+                removed.append(v)
+        return removed

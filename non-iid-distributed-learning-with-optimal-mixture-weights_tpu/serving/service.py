@@ -28,8 +28,10 @@ from concurrent.futures import Future, InvalidStateError
 import numpy as np
 
 from ..utils.trace import NULL_TRACER
-from .batcher import coalesce, drain, request_rows, split_results
+from .batcher import (coalesce, drain, partition, request_rows,
+                      split_results)
 from .metrics import ServeMetrics
+from .rollout import assigned_to_candidate
 
 
 class Overloaded(RuntimeError):
@@ -106,7 +108,7 @@ class ServingService:
     def __init__(self, engine, max_queue: int = 1024,
                  max_wait_ms: float = 2.0, metrics: ServeMetrics | None = None,
                  retries: int = 2, retry_backoff_ms: float = 5.0,
-                 tracer=None):
+                 tracer=None, router=None):
         """``retries``/``retry_backoff_ms``: bounded exponential-backoff
         retry of TRANSIENT engine-dispatch failures (``_is_transient``;
         a flapping remote-accelerator tunnel) — at most ``retries``
@@ -124,8 +126,18 @@ class ServingService:
         stage split, retry count — and the PR 2 retry/deadline events
         become ``"engine_retry"``/``"deadline_exceeded"`` annotations.
         Default is the shared no-op tracer (zero per-request cost
-        beyond the id counter)."""
+        beyond the id counter).
+
+        ``router`` (``serving.rollout.RolloutController`` attaches
+        itself here): the rollout traffic splitter. When set, the
+        worker reads one atomic ``router.split()`` snapshot per
+        micro-batch and routes the deterministically-assigned slice to
+        the candidate version — dispatched-and-discarded in shadow
+        mode, answered-from-candidate (with live fallback on failure)
+        in ab mode — reporting outcomes back via ``router.observe``.
+        None serves everything from the engine's live version."""
         self.engine = engine
+        self.router = router
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.max_queue = int(max_queue)
         self.max_wait = max_wait_ms / 1e3
@@ -133,6 +145,17 @@ class ServingService:
         self.retry_backoff = retry_backoff_ms / 1e3
         self.metrics = metrics if metrics is not None else ServeMetrics()
         self._width = engine.input_dim  # computed once, checked per submit
+        # capability check once, not per probe: whether the engine's
+        # predict supports the out-of-band record_timings=False mode
+        # (a TypeError-based fallback at dispatch time would misread a
+        # genuine TypeError from inside predict as a missing kwarg)
+        try:
+            import inspect
+
+            self._predict_untimed = "record_timings" in \
+                inspect.signature(engine.predict).parameters
+        except (TypeError, ValueError):
+            self._predict_untimed = False
         self._q: queue.Queue[_Request] = queue.Queue()
         # accepted-but-unserved request count, mutated under the lock:
         # a bare qsize()-then-put check is a race (N concurrent submits
@@ -145,23 +168,47 @@ class ServingService:
         self._thread: threading.Thread | None = None
 
     # -- tracing ------------------------------------------------------
+    def _staleness(self, version) -> int:
+        """Rounds the given version trails the newest published model
+        — from the router's registry when one is attached, else 0 (a
+        single-version service is by definition current)."""
+        r = self.router
+        if r is None or version is None:
+            return 0
+        try:
+            return int(r.staleness_rounds(version))
+        except Exception:
+            return 0
+
     def _trace_request(self, req: _Request, outcome: str, done: float,
                        queue_s=None, pad_s=None, device_s=None,
-                       batch_id=None, where=None) -> None:
+                       batch_id=None, where=None, version=None,
+                       staleness=None) -> None:
         """Emit the one ``"request"`` span a submitted request gets at
         resolution — whichever path resolved it (served, deadline,
         error, shutdown), so the exported trace holds every accepted
         request id exactly once. Deadline outcomes additionally land a
         ``"deadline_exceeded"`` annotation naming WHERE the request
         expired (queued / during retries / the post-stop sweep) — the
-        PR 2 events, now attributable."""
+        PR 2 events, now attributable. Every span carries the rollout
+        dimensions: ``model_version`` (the version that answered, or
+        the live version at resolution for unserved outcomes) and
+        ``staleness_rounds`` (how far that version trails the newest
+        published model)."""
         if not self.tracer.enabled:
             return
+        if version is None:
+            version = getattr(self.engine, "version", None)
+        if staleness is None:
+            # batch callers pass it precomputed (constant across a
+            # served group); one-off resolutions look it up here
+            staleness = self._staleness(version)
         # lean on purpose (no per-field rounding, attrs dict handed to
         # emit as-is): this runs once per served request, and its cost
         # IS the trace plane's overhead the serve bench measures
         attrs = {"outcome": outcome, "rows": request_rows(req.x),
-                 "retries": req.retries}
+                 "retries": req.retries, "model_version": version,
+                 "staleness_rounds": staleness}
         if queue_s is not None:
             attrs["queue_ms"] = queue_s * 1e3
         if pad_s is not None:
@@ -177,15 +224,19 @@ class ServingService:
                          done - req.t_submit, attrs=attrs)
 
     def _engine_stage_split(self, fallback_device_s: float) -> tuple:
-        """``(pad_s, device_s)`` of the engine call that just returned:
-        the engine's own host-timed split when it exposes one
-        (``ServingEngine.pop_timings``), else the whole call billed to
-        the device stage (honest for a custom engine with no split)."""
+        """``(pad_s, device_s, version)`` of the engine call that just
+        returned: the engine's own host-timed split when it exposes
+        one (``ServingEngine.pop_timings``) — which also names the
+        model version that actually answered — else the whole call
+        billed to the device stage with the engine's live version
+        (honest for a custom engine with no split)."""
         pop = getattr(self.engine, "pop_timings", None)
         timing = pop() if pop is not None else None
         if timing:
-            return timing["pad_s"], timing["dispatch_s"]
-        return 0.0, fallback_device_s
+            return (timing["pad_s"], timing["dispatch_s"],
+                    timing.get("version"))
+        return 0.0, fallback_device_s, getattr(self.engine, "version",
+                                               None)
 
     # -- lifecycle ----------------------------------------------------
     def start(self) -> "ServingService":
@@ -270,7 +321,7 @@ class ServingService:
                 continue
             done = time.perf_counter()
             queue_s = t_seen - req.t_submit
-            pad_s, device_s = self._engine_stage_split(done - t_seen)
+            pad_s, device_s, ver = self._engine_stage_split(done - t_seen)
             # same accounting as the worker path: served is served,
             # whichever thread resolved it — and metrics before the
             # future, so a caller's post-result snapshot counts it
@@ -279,9 +330,10 @@ class ServingService:
                 latencies=[done - req.t_submit], now=done,
                 stage_seconds={"queue": [queue_s], "pad": pad_s,
                                "device": device_s},
-                request_retries=[req.retries])
+                request_retries=[req.retries], version=ver)
             self._trace_request(req, "ok", done, queue_s=queue_s,
-                                pad_s=pad_s, device_s=device_s)
+                                pad_s=pad_s, device_s=device_s,
+                                version=ver)
             _resolve(req.future, result=out)
 
     def __enter__(self):
@@ -385,19 +437,112 @@ class ServingService:
                     live.append(req)
             if not live:
                 continue
-            self._serve_batch(live, t_formed=now)
+            self._serve_batch(live)
 
-    def _serve_batch(self, live, t_formed: float | None = None) -> None:
-        """One micro-batch through the engine, with bounded-backoff
-        retry of transient dispatch failures; every future in ``live``
-        is resolved here (result, deadline, or error) — nothing can
-        strand, whichever way the engine fails. ``t_formed`` (batch
-        formation time) closes each request's queue-wait stage; the
-        engine call's pad/device split and the retry count complete
-        the per-request stage attribution."""
-        if t_formed is None:
-            t_formed = time.perf_counter()
+    def _serve_batch(self, live) -> None:
+        """One micro-batch through the engine. With no router, the
+        whole batch is one live-version group. With an active rollout
+        split, the batch partitions INSIDE the micro-batcher by the
+        deterministic per-request-id hash (``rollout.
+        assigned_to_candidate``): shadow mode serves everyone from the
+        live version and then mirrors the assigned slice to the
+        candidate (results discarded, prediction agreement reported);
+        ab mode answers the assigned slice FROM the candidate, falling
+        back to the live version if the candidate dispatch fails. The
+        split snapshot is read once per batch — promotion/rollback
+        between batches is therefore atomic with respect to dispatch,
+        and a ``version=None`` (live) dispatch re-resolves inside the
+        engine on every attempt, so retries can never run against a
+        half-swapped engine. Stage attribution happens per GROUP (each
+        group stamps its own start): under an ab split, the candidate
+        group's wait behind the live group's dispatch is queue
+        residency, not pad time."""
         bid = self.tracer.new_id("batch") if self.tracer.enabled else None
+        router = self.router
+        split = router.split() if router is not None else None
+        if split is None:
+            self._serve_group(live, None, bid)
+            return
+        cand_ver, fraction, mode = split
+        if mode == "shadow":
+            # probe over the requests ACTUALLY served (a mid-retry
+            # deadline trim may have shed some), paired with their
+            # live outputs — alignment by construction
+            pairs = self._serve_group(live, None, bid)
+            probe = [(r, o) for r, o in pairs or []
+                     if assigned_to_candidate(r.id, fraction)]
+            if probe:
+                self._shadow_probe(probe, cand_ver, router, bid)
+            return
+        assigned, rest = partition(
+            live, lambda r: assigned_to_candidate(r.id, fraction))
+        if rest:
+            self._serve_group(rest, None, bid)
+        if assigned:
+            self._serve_group(assigned, cand_ver, bid, router=router)
+
+    def _shadow_probe(self, probe, cand_ver, router, bid) -> None:
+        """Dark-launch dispatch: the assigned ``(request, live_out)``
+        pairs' payloads run through the candidate version AFTER their
+        callers were already answered from the live outputs —
+        user-invisible by construction. Reports dispatch
+        success/failure and row-level argmax agreement (candidate vs
+        live) to the controller; the probe dispatches out-of-band
+        (``record_timings=False``) so its timing and version can
+        never be billed to a real batch — also what keeps this safe
+        to move off the worker thread later."""
+        try:
+            X, spans = coalesce([r.x for r, _ in probe])
+            if self._predict_untimed:
+                raw = self.engine.predict(X, version=cand_ver,
+                                          record_timings=False)
+            else:
+                # a custom engine without the kwarg: dispatch anyway
+                # and discard whatever timing slot it may have set
+                raw = self.engine.predict(X, version=cand_ver)
+                pop = getattr(self.engine, "pop_timings", None)
+                if pop is not None:
+                    pop()
+            couts = split_results(raw, spans)
+        except Exception as e:
+            self.metrics.record_candidate_error(len(probe))
+            if bid is not None:
+                self.tracer.annotate(
+                    "shadow_error", bid, version=cand_ver,
+                    error=type(e).__name__, n_requests=len(probe))
+            router.observe(cand_ver, errors=len(probe))
+            return
+        hits = rows = 0
+        for (_, live_out), c in zip(probe, couts):
+            a = np.argmax(np.atleast_2d(live_out), -1)
+            b = np.argmax(np.atleast_2d(c), -1)
+            hits += int(np.sum(a == b))
+            rows += int(a.size)
+        self.metrics.record_shadow(len(probe))
+        router.observe(cand_ver, served=len(probe),
+                       agreement=(hits, rows))
+
+    def _serve_group(self, live, version, bid, router=None):
+        """One request group through one engine dispatch, with
+        bounded-backoff retry of transient failures; every future in
+        ``live`` is resolved here (result, deadline, or error) —
+        nothing can strand, whichever way the engine fails.
+        ``version=None`` serves the engine's live version (re-resolved
+        at every dispatch attempt); a candidate ``version`` gets ONE
+        attempt and falls back to the live version on any failure,
+        reporting the error to ``router`` — a broken canary degrades
+        to the old model, never to a caller-visible error. Returns the
+        served ``(request, output)`` pairs (deadline-trimmed requests
+        excluded) on success, None otherwise. The group's own start
+        time closes each request's queue-wait stage; the engine
+        call's pad/device split and the retry count complete the
+        per-request stage attribution."""
+        # the GROUP's own start, not the batch formation time: under
+        # an ab split the candidate group runs after the live group's
+        # whole dispatch, and billing that gap to the pad stage would
+        # misread an ordinary canary as a host-stacking regression —
+        # it is queue residency, and lands there below
+        t_formed = time.perf_counter()
         try:
             # coalesce INSIDE the guard: mixed feature widths in
             # one micro-batch raise here, and an escape would kill
@@ -409,17 +554,37 @@ class ServingService:
                                     queue_s=t_formed - req.t_submit,
                                     batch_id=bid)
                 _resolve(req.future, exc=e)
-            return
+            return None
         coalesce_s = time.perf_counter() - t_formed
         attempt = 0
+        use_version = version
         while True:
             try:
                 t_d0 = time.perf_counter()
-                raw = self.engine.predict(X)
+                raw = (self.engine.predict(X) if use_version is None
+                       else self.engine.predict(X, version=use_version))
                 predict_s = time.perf_counter() - t_d0
                 outs = split_results(raw, spans)
                 break
             except Exception as e:
+                if use_version is not None:
+                    # candidate dispatch failed (retired mid-flight, a
+                    # broken weight set, a flapping backend — any
+                    # cause): fall back to the LIVE version for these
+                    # callers and report the error to the controller's
+                    # budget. No retry budget consumed — the live
+                    # dispatch below keeps the full transient policy.
+                    self.metrics.record_candidate_error(len(live))
+                    if bid is not None:
+                        self.tracer.annotate(
+                            "candidate_fallback", bid,
+                            version=use_version,
+                            error=type(e).__name__,
+                            n_requests=len(live))
+                    if router is not None:
+                        router.observe(use_version, errors=len(live))
+                    use_version = None
+                    continue
                 if not _is_transient(e) or attempt >= self.retries:
                     # permanent (or out of budget): fail fast, every
                     # caller told — same contract as before retries
@@ -430,7 +595,7 @@ class ServingService:
                             queue_s=t_formed - req.t_submit,
                             batch_id=bid)
                         _resolve(req.future, exc=e)
-                    return
+                    return None
                 attempt += 1
                 self.metrics.record_retry()
                 for req in live:
@@ -473,14 +638,18 @@ class ServingService:
                     live = [r for r in live
                             if r.deadline is None or now <= r.deadline]
                     if not live:
-                        return
+                        return None
                     # already coalesced once above, so this re-coalesce
                     # of a subset cannot raise
                     X, spans = coalesce([r.x for r in live])
         done = time.perf_counter()
-        pad_s, device_s = self._engine_stage_split(predict_s)
+        pad_s, device_s, served_ver = self._engine_stage_split(predict_s)
         pad_s += coalesce_s  # host-side stacking is part of the stage
         queue_waits = [t_formed - r.t_submit for r in live]
+        if use_version is not None and router is not None:
+            # candidate answered these callers; feed the controller's
+            # promotion counter (errors were reported in the loop)
+            router.observe(use_version, served=len(live))
         # metrics BEFORE resolving futures: a caller that waits on
         # its future and then snapshots must see this batch counted
         self.metrics.record_batch(
@@ -490,10 +659,15 @@ class ServingService:
             now=done,
             stage_seconds={"queue": queue_waits, "pad": pad_s,
                            "device": device_s},
-            request_retries=[r.retries for r in live])
+            request_retries=[r.retries for r in live],
+            version=served_ver)
+        stale = (self._staleness(served_ver) if self.tracer.enabled
+                 else 0)  # constant across the group: look up once
         for req, q_s in zip(live, queue_waits):
             self._trace_request(req, "ok", done, queue_s=q_s,
                                 pad_s=pad_s, device_s=device_s,
-                                batch_id=bid)
+                                batch_id=bid, version=served_ver,
+                                staleness=stale)
         for req, out in zip(live, outs):
             _resolve(req.future, result=out)
+        return list(zip(live, outs))

@@ -47,7 +47,7 @@ def _to_host(tree):
 
 def save_checkpoint(path: str, params, p=None, round_idx: int | None = None,
                     extra: dict | None = None, rff=None,
-                    feature_dtype=None) -> str:
+                    feature_dtype=None, reputation=None) -> str:
     """Save algorithm state under ``path`` (a directory). Returns the
     path actually written.
 
@@ -58,13 +58,19 @@ def save_checkpoint(path: str, params, p=None, round_idx: int | None = None,
     ``feature_dtype`` marks a narrow-feature training run
     (``prepare_setup(feature_dtype=...)``): without the marker, serving
     would silently score float32 features against a head trained on
-    narrow ones.
+    narrow ones. ``reputation`` is the final per-client trust vector of
+    a rep-defended run (``res['reputation']`` under
+    ``return_state=True``): resuming through a checkpoint without it
+    restarts every client — including a quarantined attacker — at full
+    trust.
     """
     state: dict[str, Any] = {"params": _to_host(params)}
     if p is not None:
         state["p"] = np.asarray(p)
     if round_idx is not None:
         state["round"] = int(round_idx)
+    if reputation is not None:
+        state["reputation"] = np.asarray(reputation, np.float32)
     if rff is not None:
         state["rff_W"] = np.asarray(rff[0])
         state["rff_b"] = np.asarray(rff[1])

@@ -245,6 +245,33 @@ def format_trace_summary(label: str, records) -> str:
     return "\n".join(lines)
 
 
+def format_rollout_report(rollout: dict) -> str:
+    """One human-readable line for a continuous-deployment leg (the
+    ``rollout`` section ``serve_bench.py`` emits — swap latency,
+    canary/drill verdicts, the hot-swap zero-recompile pin, and where
+    the service ended up relative to training): the serve-side mirror
+    of :func:`format_fault_report`."""
+    bits = [f"rollout [{rollout.get('mode', '?')}]:",
+            f"{rollout['swaps']} swaps"]
+    if rollout.get("swap_p50_ms") is not None:
+        bits.append(f"(p50 {rollout['swap_p50_ms']}ms, max "
+                    f"{rollout.get('swap_max_ms')}ms)")
+    if "canary" in rollout:
+        canary_ms = rollout.get("canary_ms")
+        bits.append(f"canary {rollout['canary']}"
+                    + (f" in {canary_ms}ms" if canary_ms else ""))
+    if rollout.get("rollback_drill"):
+        bits.append(f"drill {rollout['rollback_drill']}")
+    bits.append(f"in-flight p95 {rollout.get('inflight_p95_ms')}ms")
+    bits.append(
+        f"recompiles {rollout.get('recompiles_during_swaps')}")
+    if "final_version" in rollout:
+        bits.append(f"serving v{rollout['final_version']} "
+                    f"({rollout.get('staleness_rounds', 0)} rounds "
+                    "behind newest)")
+    return " ".join(str(b) for b in bits)
+
+
 def load_results(path: str) -> dict:
     """Load an ``exp1_{dataset}.pkl`` result dict (driver schema)."""
     with open(path, "rb") as f:

@@ -29,6 +29,14 @@ Design:
 - Export is JSONL (one span object per line, ``schema`` in a leading
   header line) via :meth:`Tracer.export_jsonl`;
   :func:`read_jsonl` round-trips it.
+- **Streaming** mode (:class:`RotatingJsonlWriter` passed as
+  ``Tracer(writer=...)``) is for long-lived serving loops: spans are
+  written straight to a rotating JSONL file set instead of
+  accumulating in the in-memory collector, so a service that runs for
+  days holds O(1) trace memory. Each part file carries the same
+  schema header (``read_jsonl`` reads any part); rotation is by span
+  count. The continuous-deployment bench leg (``serve_bench.py``
+  ``SERVE_TRACE=DIR``) streams through this.
 
 The process-global tracer (:func:`configure` / :func:`get_tracer`) is
 how the training side opts in without threading a tracer through every
@@ -45,6 +53,7 @@ from __future__ import annotations
 import contextlib
 import itertools
 import json
+import os
 import threading
 import time
 
@@ -105,14 +114,124 @@ class _LiveSpan:
         return False
 
 
-class Tracer:
-    """Thread-safe bounded span collector with a free disabled mode."""
+class RotatingJsonlWriter:
+    """Span sink for long-lived loops: JSONL part files rotated by
+    span count, each opening with the ``TRACE.v1`` schema header so
+    :func:`read_jsonl` reads any part standalone.
 
-    def __init__(self, enabled: bool = True, max_spans: int = 100_000):
+    Rotation keeps every part boundable (ship/delete parts while the
+    service keeps running) and the writer itself holds no spans — the
+    memory the in-memory collector would otherwise grow without bound.
+    Thread-safe: the serving worker and a publisher thread may emit
+    concurrently. ``close()`` is idempotent; writing after close
+    raises (a silent drop would break the exactly-once accounting the
+    serve bench gates on).
+    """
+
+    def __init__(self, directory: str, max_spans_per_file: int = 50_000,
+                 prefix: str = "trace"):
+        if max_spans_per_file <= 0:
+            raise ValueError("max_spans_per_file must be positive, got "
+                             f"{max_spans_per_file}")
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self.max_spans_per_file = int(max_spans_per_file)
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._file = None
+        # resume numbering PAST any parts already in the directory: a
+        # restarted process (the crash case this writer's per-span
+        # flush exists for) must never truncate the previous run's
+        # trace-00001 — those are exactly the spans worth keeping
+        tag = f"{prefix}-"
+        existing = [f[len(tag):-len(".jsonl")]
+                    for f in os.listdir(directory)
+                    if f.startswith(tag) and f.endswith(".jsonl")]
+        self._part = max((int(s) for s in existing if s.isdigit()),
+                         default=0)
+        self._in_part = 0
+        self._written = 0
+        self._closed = False
+        self.paths: list[str] = []
+
+    def _rotate_locked(self) -> None:
+        if self._file is not None:
+            self._file.close()
+        self._part += 1
+        self._in_part = 0
+        path = os.path.join(
+            self.directory, f"{self.prefix}-{self._part:05d}.jsonl")
+        self._file = open(path, "w")
+        # parts are standalone trace files: same schema family header
+        # export_jsonl writes, marked streaming (span count unknowable
+        # upfront, and dropped is structurally zero — nothing buffers)
+        self._file.write(json.dumps({
+            "schema": TRACE_SCHEMA, "streaming": True,
+            "part": self._part}) + "\n")
+        self.paths.append(path)
+
+    def write(self, rec: dict) -> None:
+        """Append one span record (the :data:`SPAN_FIELDS` subset),
+        rotating first when the current part is full."""
+        line = json.dumps({k: rec[k] for k in SPAN_FIELDS})
+        with self._lock:
+            if self._closed:
+                # a dedicated flag, not `_file is None`: closing
+                # BEFORE the first span leaves no file either, and
+                # the lazy open below must not silently resurrect a
+                # closed writer (the consumer already counted
+                # paths/spans_written)
+                raise ValueError("RotatingJsonlWriter is closed")
+            if self._file is None:
+                self._rotate_locked()
+            if self._in_part >= self.max_spans_per_file:
+                self._rotate_locked()
+            self._file.write(line + "\n")
+            # flush per span: this mode exists for processes that die
+            # without close() (OOM, preemption) and for shippers
+            # tailing the live part — buffered tails would lose the
+            # last spans and hand readers a truncated JSON line
+            self._file.flush()
+            self._in_part += 1
+            self._written += 1
+
+    @property
+    def spans_written(self) -> int:
+        with self._lock:
+            return self._written
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Tracer:
+    """Thread-safe bounded span collector with a free disabled mode.
+
+    ``writer`` (a :class:`RotatingJsonlWriter`) switches the tracer to
+    streaming: completed spans go straight to the writer's rotating
+    JSONL files and the in-memory list stays empty — ``records()``
+    returns nothing and :meth:`export_jsonl` refuses (the spans are
+    already on disk). ``max_spans``/``dropped`` do not apply; the
+    writer counts via ``spans_written``.
+    """
+
+    def __init__(self, enabled: bool = True, max_spans: int = 100_000,
+                 writer: "RotatingJsonlWriter | None" = None):
         if max_spans <= 0:
             raise ValueError(f"max_spans must be positive, got {max_spans}")
         self.enabled = bool(enabled)
         self.max_spans = int(max_spans)
+        self.writer = writer
         self._spans: list[dict] = []
         self._dropped = 0
         self._lock = threading.Lock()
@@ -152,6 +271,25 @@ class Tracer:
             "dur_s": float(dur_s),
             "attrs": attrs,
         }
+        if self.writer is not None:
+            # streaming: the id counter is already thread-safe
+            # (itertools.count) and the writer locks internally, so no
+            # collector lock is taken — the span never lands in memory
+            rec["span_id"] = f"s-{next(self._ids)}"
+            try:
+                self.writer.write(rec)
+            except (ValueError, OSError):
+                # a SUPERSEDED tracer whose writer was closed by a
+                # reconfigure, or a writer whose disk just filled
+                # (ENOSPC on the per-span flush) — either way, degrade
+                # like the bounded collector: count the span as
+                # dropped instead of raising into the emitting thread
+                # (which could be the serving worker, whose death
+                # would strand every queued future)
+                with self._lock:
+                    self._dropped += 1
+                return None
+            return rec["span_id"]
         with self._lock:
             if len(self._spans) >= self.max_spans:
                 self._dropped += 1
@@ -200,6 +338,11 @@ class Tracer:
     def export_jsonl(self, path: str) -> int:
         """Write ``{schema header}\\n{span}\\n...``; returns the span
         count written (header excluded)."""
+        if self.writer is not None:
+            raise ValueError(
+                "streaming tracer: spans were already exported to "
+                f"{self.writer.directory!r} as they were emitted "
+                "(writer.paths lists the part files)")
         recs = self.records()
         with open(path, "w") as f:
             f.write(json.dumps({"schema": TRACE_SCHEMA,
@@ -231,15 +374,34 @@ _global_tracer: Tracer = NULL_TRACER
 _global_lock = threading.Lock()
 
 
-def configure(enabled: bool = True, max_spans: int = 1_000_000) -> Tracer:
+def configure(enabled: bool = True, max_spans: int = 1_000_000,
+              stream_dir: str | None = None,
+              rotate_spans: int = 50_000) -> Tracer:
     """Install (and return) the process-global tracer — how ``exp.py
     --trace_dir`` turns on per-round training spans without threading a
     tracer through every algorithm signature. ``configure(False)``
-    restores the free :data:`NULL_TRACER`."""
+    restores the free :data:`NULL_TRACER`. ``stream_dir`` makes the
+    tracer stream spans to a :class:`RotatingJsonlWriter` there (the
+    long-lived-loop mode: O(1) trace memory; ``rotate_spans`` bounds
+    each part file)."""
     global _global_tracer
     with _global_lock:
-        _global_tracer = (Tracer(enabled=True, max_spans=max_spans)
-                          if enabled else NULL_TRACER)
+        # build the incoming tracer FIRST: if its writer cannot open
+        # (unwritable stream_dir), the old tracer must stay fully
+        # functional — closing it before a failed swap would leave a
+        # process-wide tracer that raises on every emit
+        if not enabled:
+            new = NULL_TRACER
+        else:
+            writer = (RotatingJsonlWriter(stream_dir, rotate_spans)
+                      if stream_dir else None)
+            new = Tracer(enabled=True, max_spans=max_spans,
+                         writer=writer)
+        old, _global_tracer = _global_tracer, new
+        if old.writer is not None:
+            # the outgoing streaming tracer's part file would stay
+            # open forever otherwise — one leaked fd per reconfigure
+            old.writer.close()
         return _global_tracer
 
 

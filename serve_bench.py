@@ -37,15 +37,33 @@ grows ``phases`` (build / compile-warmup / timed-run seconds) and a
 ``trace`` section; recompiles-after-warmup is checked across ALL
 streams.
 
+The ISSUE 6 continuous-deployment leg (``loop_bench``) closes the
+train->serve loop under load: the trained model is re-published as
+SERVE_SWAPS successive registry versions and hot-swapped into the live
+engine while a request stream runs — bare swaps timed individually
+(install + live-pointer flip, zero recompiles pinned across ALL of
+them), then one full shadow-canary promotion (deterministic
+per-request-id split, promotion after a live-traffic budget), then a
+deliberate parity-gate failure that must ROLL BACK (sign-flipped
+weights published under the clean model's eval accuracy). The artifact
+grows a ``rollout`` section (swap latency percentiles, in-flight
+latency across swaps, canary/drill verdicts, final version +
+staleness) and the schema bumps to BENCH_SERVE.v2; with SERVE_TRACE
+set the loop's spans stream through the rotating JSONL writer
+(``utils.trace.RotatingJsonlWriter``) instead of the in-memory
+collector — the long-lived-loop mode.
+
 Env knobs: SERVE_BUCKETS ("1,8,64,512"), SERVE_D (RFF width, 256),
 SERVE_N (train rows, 4096), SERVE_CLIENTS (8), SERVE_TRAIN_ROUNDS (2),
 SERVE_ITERS (per-bucket timed calls, 30), SERVE_REQUESTS (mixed-stream
-requests, 200), SERVE_MAX_WAIT_MS (2.0), SERVE_CKPT (serve an existing
-checkpoint dir instead of training), SERVE_OUT, SERVE_ROUND (artifact
-suffix, default 1), SERVE_TRACE (directory: export the traced leg's
-span records as JSONL there), BENCH_PROFILE_DIR (jax.profiler capture
-of the timed section, shared with bench.py via
-bench_common.profile_ctx).
+requests, 200), SERVE_MAX_WAIT_MS (2.0), SERVE_SWAPS (hot swaps in the
+rollout leg, default 3, floor 2 — the series is N-1 bare timed swaps
+plus one shadow canary), SERVE_CKPT (serve an existing checkpoint dir instead
+of training), SERVE_OUT, SERVE_ROUND (artifact suffix, default 1),
+SERVE_TRACE (directory: export the traced leg's span records as JSONL
+there, and stream the rollout leg's spans there as rotating parts),
+BENCH_PROFILE_DIR (jax.profiler capture of the timed section, shared
+with bench.py via bench_common.profile_ctx).
 """
 
 import json
@@ -53,6 +71,7 @@ import os
 import shutil
 import sys
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -163,6 +182,194 @@ def mixed_stream(engine, n_requests: int, max_wait_ms: float, rng,
     snap["throughput_req_per_s"] = round(len(payloads) / dt, 2)
     snap["throughput_rows_per_s"] = round(sum(sizes) / dt, 2)
     return snap
+
+
+def _wait_live(engine, v, timeout_s: float) -> bool:
+    """Poll until ``v`` is the engine's live version (a promote may
+    land on the serving worker thread a beat after ``stage``
+    returns); True when it took within the timeout."""
+    deadline = time.perf_counter() + timeout_s
+    while engine.version != v and time.perf_counter() < deadline:
+        time.sleep(0.001)
+    return engine.version == v
+
+
+def loop_bench(engine, parity_xy, eval_acc, n_swaps, max_wait_ms, rng,
+               trace_dir=None):
+    """Continuous deployment under live traffic (see module
+    docstring): bare hot swaps timed one by one, a shadow-canary
+    promotion, and a parity-failure rollback drill, all against one
+    uninterrupted request stream. Returns the artifact ``rollout``
+    section. The stream pumps until the rollout script finishes (a
+    swap's cost must be measured against in-flight traffic, not an
+    idle service); with ``trace_dir`` the spans stream through the
+    rotating JSONL writer — the collector-free long-lived-loop mode.
+    """
+    from fedamw_tpu.serving import (LatencyHistogram, ModelRegistry,
+                                    Overloaded, RolloutController,
+                                    ServingService)
+    from fedamw_tpu.utils.trace import RotatingJsonlWriter, Tracer
+
+    params = {k: np.asarray(v) for k, v in engine.params.items()}
+    rff = engine.rff
+    if rff is not None:
+        rff = (np.asarray(rff[0]), np.asarray(rff[1]))
+    registry = ModelRegistry()
+    meta = None if eval_acc is None else {"eval_acc": eval_acc}
+    # the SAME trained weights re-published as successive training
+    # rounds: this leg measures swap/rollout MECHANICS (latency,
+    # recompiles, gates), and identical weights make the parity gate
+    # exact and the shadow agreement 1.0 by construction. Floor of 2:
+    # the series is (n-1) bare timed swaps + 1 shadow canary, and the
+    # v2 artifact contract needs at least one timed bare swap for
+    # swap_p50_ms
+    versions = [registry.publish(params, rff=rff, round_idx=k + 1,
+                                 metadata=meta)
+                for k in range(max(2, n_swaps))]
+    writer = tracer = None
+    if trace_dir:
+        writer = RotatingJsonlWriter(trace_dir, max_spans_per_file=2000,
+                                     prefix="serve_loop")
+        tracer = Tracer(writer=writer)
+    sizes = [1, 8, max(1, engine.buckets[-1] // 2)]
+    payloads = [rng.randn(s, engine.input_dim).astype(np.float32)
+                for s in sizes]
+    stop = threading.Event()
+    pump_errors: list = []
+
+    def pump():
+        # bounded in-flight window: resolved results are consumed as
+        # the stream runs (a fast backend could otherwise accumulate
+        # O(100k) result arrays before a final drain), and any
+        # failure is carried out to the main thread
+        import collections
+
+        pending: collections.deque = collections.deque()
+        i = 0
+        try:
+            while not stop.is_set() and i < 100_000:
+                try:
+                    f = svc.submit(payloads[i % len(payloads)])
+                except Overloaded:
+                    time.sleep(0.001)
+                    continue
+                pending.append(f)
+                i += 1
+                if len(pending) >= 512:
+                    pending.popleft().result(timeout=300)
+            for f in pending:
+                f.result(timeout=300)
+        except Exception as e:  # surfaced after join, below
+            pump_errors.append(e)
+
+    swap_ms = []
+    swap_hist = LatencyHistogram()  # one percentile impl, not a copy
+    cc0 = engine.compile_count
+    with ServingService(engine, max_wait_ms=max_wait_ms,
+                        max_queue=4096, tracer=tracer) as svc:
+        ctl = RolloutController(svc, registry, mode="shadow",
+                                fraction=0.5, min_requests=0,
+                                error_budget=0, parity_data=None)
+        th = threading.Thread(target=pump, name="loop-pump")
+        th.start()
+        try:
+            # 1) bare hot swaps, timed individually: install the new
+            # version's weights + flip the live pointer (min_requests=0
+            # promotes inside stage; no parity data -> no gate
+            # dispatch in the timing window)
+            for v in versions[:-1]:
+                t0 = time.perf_counter()
+                took = ctl.stage(v) and _wait_live(engine, v, 10)
+                dt = time.perf_counter() - t0
+                swap_hist.record(dt)
+                swap_ms.append(round(dt * 1e3, 3))
+                if not took:
+                    raise SystemExit(
+                        f"# serve_bench aborted: bare swap to version "
+                        f"{v} did not take (live={engine.version})")
+            # 2) the last version promotes through a REAL shadow
+            # canary: deterministic split, candidate dispatched on
+            # live traffic, promotion after min_requests clean
+            # observations
+            ctl.min_requests = 16
+            ctl.min_agreement = 0.99
+            canary_v = versions[-1]
+            t0 = time.perf_counter()
+            ok = ctl.stage(canary_v)
+            took = ok and _wait_live(engine, canary_v, 60)
+            canary_ms = round((time.perf_counter() - t0) * 1e3, 3)
+            canary = "promoted" if took else "FAILED"
+            # 3) rollback drill: sign-flipped weights published under
+            # the clean model's eval accuracy MUST fail the parity
+            # gate and leave the canary winner serving. Only after a
+            # promoted canary: a timed-out canary is still staged, and
+            # staging the drill on top would raise instead of reaching
+            # the structured FAILED abort below — clear it first.
+            if canary != "promoted" and ok:
+                ctl.rollback("canary timed out in loop_bench")
+            drill = "skipped"
+            if (canary == "promoted" and parity_xy is not None
+                    and eval_acc is not None):
+                ctl.parity_data = parity_xy
+                ctl.min_requests = 0
+                bad = registry.publish(
+                    {k: -v for k, v in params.items()}, rff=rff,
+                    round_idx=len(versions) + 1, metadata=dict(meta))
+                live_before = engine.version
+                staged = ctl.stage(bad)
+                drill = ("rolled_back" if not staged
+                         and engine.version == live_before
+                         else "FAILED")
+                # withdraw the rejected publish: the artifact's final
+                # staleness must describe servable models, not the
+                # drill's deliberately-bad one
+                registry.withdraw(bad)
+        finally:
+            stop.set()
+            th.join(timeout=60)
+        if pump_errors:
+            raise SystemExit(
+                f"# serve_bench aborted: rollout-leg request failed: "
+                f"{type(pump_errors[0]).__name__}: {pump_errors[0]}")
+        snap = svc.metrics.snapshot(engine)
+    if writer is not None:
+        writer.close()
+    events = [dict(e) for e in ctl.events]
+    gate = next((e.get("gate") for e in reversed(events)
+                 if e.get("stage") == "parity"), None)
+    recompiles = engine.compile_count - cc0
+    swap_pcts = swap_hist.percentiles((50, 95))
+    section = {
+        "mode": "shadow",
+        "swaps": len(swap_ms) + int(canary == "promoted"),
+        "swap_p50_ms": swap_pcts["p50_ms"],
+        "swap_p95_ms": swap_pcts["p95_ms"],
+        "swap_max_ms": max(swap_ms) if swap_ms else None,
+        "canary": canary,
+        "canary_ms": canary_ms,
+        "rollback_drill": drill,
+        "drill_gate": gate,
+        "inflight_p50_ms": snap["p50_ms"],
+        "inflight_p95_ms": snap["p95_ms"],
+        "requests": snap["requests"],
+        "shadow_requests": snap["shadow_requests"],
+        "candidate_errors": snap["candidate_errors"],
+        "rollbacks": snap["rollbacks"],
+        "weight_swaps": snap["weight_swaps"],
+        "recompiles_during_swaps": recompiles,
+        "final_version": engine.version,
+        "staleness_rounds": registry.staleness_rounds(engine.version),
+        "trace_parts": len(writer.paths) if writer else 0,
+        "trace_spans": writer.spans_written if writer else 0,
+    }
+    if canary == "FAILED" or drill == "FAILED" or recompiles:
+        # rollout gates are abort-grade, like parity: a swap that
+        # recompiled or a drill that served bad weights must never
+        # emit green-looking numbers
+        print(f"# serve_bench aborted: rollout leg failed "
+              f"({json.dumps(section)})", file=sys.stderr)
+        raise SystemExit(1)
+    return section
 
 
 def main():
@@ -284,8 +491,25 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
                 tracer, traced = t, on_snap
     timed_s = time.perf_counter() - t_timed0
 
-    # the zero-recompile pin now spans BOTH streams: tracing must not
-    # perturb the shape discipline (host-side timestamps only)
+    # ISSUE 6: the continuous-deployment leg — hot swaps + a shadow
+    # canary + a rollback drill against live traffic, swap latency and
+    # in-flight tails measured, spans streamed when SERVE_TRACE is set
+    t_loop0 = time.perf_counter()
+    rollout = loop_bench(
+        engine, parity_xy=((X_test_raw, np.asarray(setup.y_test))
+                           if setup is not None else None),
+        eval_acc=(parity["engine_acc"] if parity is not None else None),
+        n_swaps=_env_int("SERVE_SWAPS", 3), max_wait_ms=max_wait_ms,
+        rng=np.random.RandomState(7),
+        trace_dir=os.environ.get("SERVE_TRACE") or None)
+    loop_s = time.perf_counter() - t_loop0
+    from fedamw_tpu.utils.reporting import format_rollout_report
+
+    print(f"# {format_rollout_report(rollout)}", file=sys.stderr)
+
+    # the zero-recompile pin now spans EVERY stream — untraced, traced,
+    # and the rollout leg's swapped versions: tracing must not perturb
+    # the shape discipline, and neither may a weight swap
     recompiles = engine.compile_count - warm_compiles
     print(f"# mixed stream: {stream['requests']} requests in "
           f"{stream['batches']} batches, p50 {stream['p50_ms']}ms "
@@ -323,7 +547,10 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
 
     artifact = {
         "metric": "serve_bench",
-        "schema": "BENCH_SERVE.v1",
+        # v2: the rollout section (continuous-deployment leg) is part
+        # of the contract — tools/check_bench_schema.py requires it
+        # from v2 on (v1 artifacts are grandfathered by version)
+        "schema": "BENCH_SERVE.v2",
         "platform": platform,
         "engine": {
             "buckets": list(engine.buckets),
@@ -336,9 +563,11 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
                    "seconds": round(warmup_s, 3)},
         "phases": {"build_s": round(build_s, 3),
                    "compile_warmup_s": round(warmup_s, 3),
-                   "timed_run_s": round(timed_s, 3)},
+                   "timed_run_s": round(timed_s, 3),
+                   "rollout_s": round(loop_s, 3)},
         "bucket_latency": bucket_latency,
         "mixed_stream": stream,
+        "rollout": rollout,
         "trace": {
             "request_spans": len(req_spans),
             "unique_request_ids": len(set(ids)),
@@ -363,6 +592,21 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
     with open(out_path, "w") as f:
         json.dump(artifact, f, indent=1)
     print(f"# artifact -> {out_path}", file=sys.stderr)
+
+    # the rollout-leg line (before the headline, which stays LAST):
+    # swap latency is the number an operator sizes a publish cadence by
+    print(json.dumps({
+        "metric": "serve_rollout",
+        "value": rollout["swap_p50_ms"],
+        "unit": "ms/swap",
+        "swaps": rollout["swaps"],
+        "canary": rollout["canary"],
+        "rollback_drill": rollout["rollback_drill"],
+        "inflight_p95_ms": rollout["inflight_p95_ms"],
+        "recompiles_during_swaps": rollout["recompiles_during_swaps"],
+        "final_version": rollout["final_version"],
+        "platform": platform,
+    }))
 
     # the trace-plane cost line (before the headline, which stays LAST)
     print(json.dumps({

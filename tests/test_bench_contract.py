@@ -232,6 +232,41 @@ def test_bench_sweep_only_contract():
     assert "reference-loop" not in out.stderr
 
 
+def test_serve_bench_rollout_leg_respects_swap_knob(tmp_path):
+    """The serve driver's ISSUE 6 rollout leg (the serve-side sibling
+    of the env-gated bench legs above): SERVE_SWAPS sizes the hot-swap
+    series, the serve_rollout JSON line precedes the headline (which
+    stays LAST for the driver's final-line parse), and the swap
+    zero-recompile pin holds at a non-default swap count. The full
+    rollout-leg contract is pinned in test_serve_contract.py; this
+    pins the driver-facing knob."""
+    out_path = str(tmp_path / "BENCH_SERVE_knob.json")
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", SERVE_OUT=out_path,
+               SERVE_BUCKETS="1,8,32", SERVE_D="64", SERVE_N="1024",
+               SERVE_TRAIN_ROUNDS="1", SERVE_ITERS="3",
+               SERVE_REQUESTS="40", SERVE_SWAPS="5",
+               SERVE_TRACE_REPS="1")
+    env.pop("BENCH_STRICT_TPU", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "serve_bench.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=570,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [json.loads(ln) for ln in out.stdout.splitlines() if ln]
+    assert lines[-1]["metric"] == "serve_requests_per_sec"
+    roll = [l for l in lines if l["metric"] == "serve_rollout"]
+    assert len(roll) == 1
+    assert roll[0]["swaps"] == 5  # 4 bare + 1 shadow canary
+    assert roll[0]["recompiles_during_swaps"] == 0
+    assert roll[0]["canary"] == "promoted"
+    assert lines[-1]["recompiles_after_warmup"] == 0
+    with open(out_path) as f:
+        art = json.load(f)
+    assert art["rollout"]["swaps"] == 5
+    assert art["rollout"]["final_version"] == 5
+
+
 def test_dryrun_multichip_succeeds_without_backend_query():
     """`python -c "import __graft_entry__ as g; g.dryrun_multichip(4)"`
     completes via the respawn-first path (no respawn-skip vars set).

@@ -81,6 +81,60 @@ def test_rejects_serve_artifact_drift(tmp_path):
         assert cbs.validate_file(p), f"accepted broken {key}"
 
 
+GOOD_ROLLOUT = {"mode": "shadow", "swaps": 3, "swap_p50_ms": 1.2,
+                "swap_p95_ms": 2.0, "inflight_p95_ms": 9.5,
+                "canary": "promoted", "rollback_drill": "rolled_back",
+                "recompiles_during_swaps": 0, "final_version": 3,
+                "staleness_rounds": 0}
+
+
+def test_serve_v2_requires_rollout_section(tmp_path):
+    """From schema v2 on, the continuous-deployment leg's 'rollout'
+    section is contract; v1 artifacts (r01) are grandfathered by
+    schema version — strict for everything that could carry it."""
+    art = {"metric": "serve_bench", "schema": "BENCH_SERVE.v2",
+           "platform": "cpu",
+           "bucket_latency": {"1": {"p50_ms": 0.1, "p99_ms": 0.2}},
+           "mixed_stream": {"requests": 10},
+           "recompiles_after_warmup": 0}
+    p = _write(tmp_path, "BENCH_SERVE_r09.json", art)
+    errs = cbs.validate_file(p)
+    assert any("rollout" in e for e in errs)
+    good = dict(art, rollout=dict(GOOD_ROLLOUT))
+    assert cbs.validate_file(
+        _write(tmp_path, "BENCH_SERVE_r09.json", good)) == []
+    # v1 stays valid without the section (the committed r01 shape)
+    v1 = dict(art, schema="BENCH_SERVE.v1")
+    assert cbs.validate_file(
+        _write(tmp_path, "BENCH_SERVE_r09.json", v1)) == []
+    # an unparseable version suffix must NOT skip the v2 rules silently
+    weird = dict(art, schema="BENCH_SERVE.v2-rc1")
+    errs = cbs.validate_file(
+        _write(tmp_path, "BENCH_SERVE_r09.json", weird))
+    assert any("unparseable schema version" in e for e in errs)
+
+
+def test_serve_v2_rejects_rollout_drift(tmp_path):
+    base = {"metric": "serve_bench", "schema": "BENCH_SERVE.v2",
+            "platform": "cpu",
+            "bucket_latency": {"1": {"p50_ms": 0.1, "p99_ms": 0.2}},
+            "mixed_stream": {"requests": 10},
+            "recompiles_after_warmup": 0}
+    for key, bad in (("swaps", 0), ("swap_p50_ms", None),
+                     ("inflight_p95_ms", "fast"),
+                     ("recompiles_during_swaps", None),
+                     ("canary", ""), ("rollback_drill", "FAILED"),
+                     ("staleness_rounds", None)):
+        rollout = dict(GOOD_ROLLOUT, **{key: bad})
+        p = _write(tmp_path, "BENCH_SERVE_r09.json",
+                   dict(base, rollout=rollout))
+        assert cbs.validate_file(p), f"accepted broken rollout {key}"
+    # a canary that FAILED must never land green in a committed file
+    p = _write(tmp_path, "BENCH_SERVE_r09.json",
+               dict(base, rollout=dict(GOOD_ROLLOUT, canary="FAILED")))
+    assert any("FAILED" in e for e in cbs.validate_file(p))
+
+
 def test_rejects_multichip_ok_rc_disagreement(tmp_path):
     p = _write(tmp_path, "MULTICHIP_r09.json",
                {"n_devices": 8, "rc": 124, "ok": True, "tail": "OK"})

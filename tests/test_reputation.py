@@ -386,6 +386,71 @@ def test_defense_report_carries_reputation_and_threshold(setup_het):
     assert "total_lied" not in fault_summary(legacy)
 
 
+# -- checkpoint persistence (ISSUE 6 satellite) -----------------------
+
+def test_reputation_roundtrips_through_checkpoint(tmp_path, setup_het):
+    """Prefix + checkpoint (reputation included) + resume == the
+    uninterrupted run, bitwise — including the reputation trajectory
+    itself. Without persistence, a resumed run would restart the
+    sign-flipper at full trust; with it, the flipper stays distrusted
+    across the boundary."""
+    from fedamw_tpu.utils.checkpoint import (load_checkpoint,
+                                             save_checkpoint)
+
+    R, J = 6, setup_het.num_clients
+    plan = sign_plan(R, J, 2)
+    kw = dict(faults=plan, robust_agg="rep:0.5:0.2",
+              return_state=True, **KW)
+    full = FedAvg(setup_het, round=R, **kw)
+    prefix = FedAvg(setup_het, round=R, stop_round=3, **kw)
+    # the flipper is already below full trust at the boundary
+    assert prefix["reputation"][2] < 1.0
+    save_checkpoint(str(tmp_path / "ck"), prefix["params"],
+                    round_idx=3, reputation=prefix["reputation"])
+    state = load_checkpoint(str(tmp_path / "ck"))
+    np.testing.assert_array_equal(
+        np.asarray(state["reputation"], np.float32),
+        np.asarray(prefix["reputation"], np.float32))
+    resumed = FedAvg(setup_het, round=R, start_round=3,
+                     resume_from=state, **kw)
+    np.testing.assert_array_equal(np.asarray(resumed["test_acc"]),
+                                  np.asarray(full["test_acc"])[3:])
+    np.testing.assert_array_equal(
+        np.asarray(resumed["defense"]["reputation"]),
+        np.asarray(full["defense"]["reputation"])[3:])
+    np.testing.assert_array_equal(np.asarray(resumed["reputation"]),
+                                  np.asarray(full["reputation"]))
+
+
+def test_resume_without_reputation_warns_and_restarts_trust(setup_het):
+    """The legacy-checkpoint path: resuming a rep-defended run from a
+    state without 'reputation' restarts everyone at full trust — loud
+    (a warning naming the fix), not silent."""
+    R, J = 6, setup_het.num_clients
+    plan = sign_plan(R, J, 2)
+    kw = dict(faults=plan, robust_agg="rep:0.5:0.2",
+              return_state=True, **KW)
+    prefix = FedAvg(setup_het, round=R, stop_round=3, **kw)
+    with pytest.warns(UserWarning, match="reputation"):
+        resumed = FedAvg(setup_het, round=R, start_round=3,
+                         resume_from={"params": prefix["params"]}, **kw)
+    # restarted trust: round-3 reputation re-decays from 1.0, so the
+    # flipper is MORE trusted than in the carried prefix state
+    assert resumed["defense"]["reputation"][0][2] > \
+        prefix["reputation"][2]
+
+
+def test_resume_rejects_cohort_size_mismatch(setup_het):
+    R = 4
+    prefix = FedAvg(setup_het, round=R, stop_round=2,
+                    robust_agg="rep", return_state=True, **KW)
+    with pytest.raises(ValueError, match="cohort"):
+        FedAvg(setup_het, round=R, start_round=2,
+               resume_from={"params": prefix["params"],
+                            "reputation": np.ones(3, np.float32)},
+               robust_agg="rep", **KW)
+
+
 def test_rep_soft_only_mode_downweights_without_gating(setup_het):
     """floor=0 is soft-only: nobody is ever hard-gated, but the
     flipper's reputation (and so its relative weight) still sinks —

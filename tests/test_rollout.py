@@ -1,0 +1,666 @@
+"""The online train->serve loop (ISSUE 6): versioned registry, hot
+weight swap, shadow/A-B rollout.
+
+Load-bearing contracts:
+
+- **Zero-recompile hot swap**: ``compile_count`` stays flat across >= 3
+  ``swap_weights`` under live traffic (the bucket ladder is compiled
+  once; weights are jit arguments), and swap-incompatible weights are
+  refused BEFORE anything changes.
+- **Deterministic split**: shadow/A-B assignment is a pure function of
+  the request id (crc32, stable across processes), monotone in the
+  fraction.
+- **Gated traffic**: a candidate takes traffic only after the offline
+  parity gate passes (``engine_acc == evaluate_acc``); a gate failure
+  retires the candidate and the prior version never stops serving
+  (rollback pin). The live-traffic error budget rolls a flaky
+  candidate back, with A/B callers transparently answered from the
+  live version.
+- **Observability**: every request span carries ``model_version`` and
+  ``staleness_rounds``; the metrics snapshot carries the swap/canary
+  counters and per-version served split.
+- **Atomicity**: a swap is atomic w.r.t. batch dispatch — under
+  concurrent submit + rapid swaps every result is EXACTLY one
+  installed version's output (params and rff can never mix), and a
+  retried request re-resolves the live version (a request queued
+  against version k must not dispatch against a half-swapped engine).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedamw_tpu.serving import (ModelRegistry, RolloutController,
+                                ServingEngine, ServingService,
+                                assigned_to_candidate, split_key)
+from fedamw_tpu.utils.trace import Tracer
+
+D, C = 16, 3
+
+
+def base_params(scale=1.0, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"w": (scale * rng.randn(C, D)).astype(np.float32)}
+
+
+def make_engine(buckets=(1, 8, 32), rff=False, **kw):
+    rng = np.random.RandomState(1)
+    r = None
+    if rff:
+        r = (rng.randn(8, D).astype(np.float32),
+             rng.randn(D).astype(np.float32))
+        kw.setdefault("params", {"w": rng.randn(C, D).astype(np.float32)})
+    params = kw.pop("params", base_params())
+    e = ServingEngine(params, rff=r, buckets=buckets, **kw)
+    e.warmup()
+    return e
+
+
+# -- registry ---------------------------------------------------------
+
+def test_registry_publish_get_latest_staleness():
+    reg = ModelRegistry()
+    assert reg.latest() is None and len(reg) == 0
+    v1 = reg.publish(base_params(), round_idx=2,
+                     metadata={"eval_acc": 91.25})
+    v2 = reg.publish(base_params(2.0), round_idx=7)
+    assert v2 == v1 + 1 and reg.versions() == [v1, v2]
+    assert reg.latest().version == v2
+    assert reg.get(v1).eval_acc == 91.25 and reg.get(v2).eval_acc is None
+    # staleness: rounds the newest publish is ahead of a version
+    assert reg.staleness_rounds(v1) == 5
+    assert reg.staleness_rounds(v2) == 0
+    assert reg.staleness_rounds(999) == 0  # unknown stays 0, not huge
+    with pytest.raises(KeyError, match="not in registry"):
+        reg.get(999)
+    # withdrawing a gate-rejected publish stops it counting toward
+    # everyone else's staleness
+    assert reg.withdraw(v2) is True and reg.withdraw(v2) is False
+    assert reg.staleness_rounds(v1) == 0
+
+
+def test_registry_publish_checkpoint_carries_markers(tmp_path):
+    from fedamw_tpu.utils.checkpoint import save_checkpoint
+
+    rng = np.random.RandomState(3)
+    rff = (rng.randn(8, D).astype(np.float32),
+           rng.randn(D).astype(np.float32))
+    save_checkpoint(str(tmp_path / "ck"), base_params(), p=np.ones(4) / 4,
+                    round_idx=6, rff=rff, extra={"eval_acc": 88.5})
+    reg = ModelRegistry()
+    v = reg.publish_checkpoint(str(tmp_path / "ck"))
+    entry = reg.get(v)
+    assert entry.round_idx == 6 and entry.eval_acc == 88.5
+    assert entry.source.startswith("checkpoint:")
+    np.testing.assert_array_equal(entry.rff[0], rff[0])
+    # the published params serve: straight into an engine (raw width
+    # comes from the checkpointed draw: rff_W is (d_raw, D_features))
+    engine = ServingEngine(entry.params, rff=entry.rff, buckets=(8,))
+    assert engine.input_dim == rff[0].shape[0]
+
+
+def test_registry_prune_keeps_protected():
+    reg = ModelRegistry()
+    vs = [reg.publish(base_params(), round_idx=k) for k in range(5)]
+    removed = reg.prune(keep=2, protect=(vs[0],))
+    assert vs[0] in reg and vs[-1] in reg
+    assert len(reg) == 2 + 1 - 1  # keep=2 total, protected survives
+    for v in removed:
+        assert v not in reg
+
+
+# -- hot swap ---------------------------------------------------------
+
+def test_swap_zero_recompile_and_output_flip():
+    engine = make_engine()
+    cc = engine.compile_count
+    X = np.random.RandomState(5).randn(4, D).astype(np.float32)
+    out0 = engine.predict(X)
+    for k in (2.0, 3.0, 4.0):  # >= 3 swaps, compile count pinned flat
+        v = engine.swap_weights(base_params(k))
+        np.testing.assert_allclose(engine.predict(X), k * out0,
+                                   rtol=1e-5)
+        assert engine.version == v
+    assert engine.compile_count == cc
+    assert engine.swap_count == 3
+    # install-and-flip REPLACES: a swap-per-round loop holds ONE
+    # version on device, not every generation it ever served
+    assert engine.versions_installed == [engine.version]
+
+
+def test_swap_rejects_incompatible_and_leaves_live_serving():
+    engine = make_engine()
+    X = np.random.RandomState(5).randn(2, D).astype(np.float32)
+    want = engine.predict(X)
+    with pytest.raises(ValueError, match="swap-incompatible"):
+        engine.swap_weights({"w": np.zeros((C, D + 1), np.float32)})
+    with pytest.raises(ValueError, match="structure differs"):
+        engine.swap_weights({"w": want, "extra": want})
+    with pytest.raises(ValueError, match="rff-ness"):
+        engine.swap_weights(base_params(), rff=(
+            np.zeros((8, D), np.float32), np.zeros(D, np.float32)))
+    np.testing.assert_array_equal(engine.predict(X), want)
+    assert engine.swap_count == 0
+
+
+def test_auto_version_swap_never_clobbers_staged_candidate():
+    """swap_weights(params) auto-versions past EVERY installed slot —
+    a staged rollout candidate must survive a direct swap landing
+    next to it."""
+    engine = make_engine()
+    X = np.random.RandomState(5).randn(2, D).astype(np.float32)
+    out0 = engine.predict(X)
+    engine.install_weights(1, base_params(5.0))  # staged candidate
+    v = engine.swap_weights(base_params(2.0))
+    assert v == 2  # past the staged slot, never onto it
+    np.testing.assert_allclose(engine.predict(X, version=1), 5 * out0,
+                               rtol=1e-5)
+    np.testing.assert_allclose(engine.predict(X), 2 * out0, rtol=1e-5)
+
+
+def test_router_slot_is_singular_and_detachable():
+    engine = make_engine()
+    reg = ModelRegistry()
+    cand = reg.publish(base_params(2.0), round_idx=1)
+    with ServingService(engine, max_wait_ms=0.5) as svc:
+        a = RolloutController(svc, reg, mode="shadow", fraction=0.5,
+                              min_requests=10 ** 6)
+        assert a.stage(cand)
+        # a second controller must not silently orphan A's rollout
+        with pytest.raises(ValueError, match="already has a router"):
+            RolloutController(svc, reg, mode="shadow", fraction=0.5)
+        a.detach()  # rolls back the in-flight candidate, frees slot
+        assert cand not in engine.versions_installed
+        assert svc.router is None
+        b = RolloutController(svc, reg, mode="shadow", fraction=0.5,
+                              min_requests=0)
+        assert b.stage(cand) and engine.version == cand
+
+
+def test_min_agreement_is_shadow_only():
+    """ab mode has no paired live outputs to measure agreement on —
+    configuring the floor there must refuse loudly, not silently
+    never enforce."""
+    engine = make_engine()
+    with ServingService(engine, max_wait_ms=0.5) as svc:
+        with pytest.raises(ValueError, match="shadow-mode"):
+            RolloutController(svc, ModelRegistry(), mode="ab",
+                              min_agreement=0.9)
+
+
+def test_parity_gate_dispatch_never_pollutes_worker_timings():
+    """The controller's parity-gate predict runs on another thread;
+    with record_timings=False it must not land in the pop_timings
+    slot the serving worker attributes spans from."""
+    engine = make_engine()
+    X = np.random.RandomState(5).randn(4, D).astype(np.float32)
+    engine.predict(X)  # worker-style call: populates the slot
+    engine.install_weights(9, base_params(3.0))
+    engine.predict(X, version=9, record_timings=False)
+    t = engine.pop_timings()
+    assert t is not None and t["version"] == engine.version  # not 9
+    assert engine.pop_timings() is None
+
+
+def test_install_retire_and_explicit_version_dispatch():
+    engine = make_engine()
+    X = np.random.RandomState(5).randn(3, D).astype(np.float32)
+    out0 = engine.predict(X)
+    engine.install_weights(7, base_params(2.0))
+    # staged, not live: default dispatch unchanged, explicit reaches it
+    np.testing.assert_array_equal(engine.predict(X), out0)
+    np.testing.assert_allclose(engine.predict(X, version=7), 2 * out0,
+                               rtol=1e-5)
+    with pytest.raises(ValueError, match="live"):
+        engine.retire(engine.version)
+    # a staged (possibly gated) slot must not be silently replaced
+    with pytest.raises(ValueError, match="already installed"):
+        engine.install_weights(7, base_params(9.0))
+    engine.retire(7)
+    with pytest.raises(KeyError, match="not installed"):
+        engine.predict(X, version=7)
+    with pytest.raises(KeyError, match="not installed"):
+        engine.retire(7)  # double-retire is a bug, not a no-op
+    engine.install_weights(7, base_params(9.0))  # retire -> re-stage ok
+
+
+# -- deterministic split ----------------------------------------------
+
+def test_split_assignment_is_deterministic_and_monotone():
+    ids = [f"req-{i}" for i in range(2000)]
+    a1 = [assigned_to_candidate(i, 0.3) for i in ids]
+    a2 = [assigned_to_candidate(i, 0.3) for i in ids]
+    assert a1 == a2  # pure function of the id
+    # monotone ramp: everyone at 0.3 is still assigned at 0.6
+    a_wide = [assigned_to_candidate(i, 0.6) for i in ids]
+    assert all(w for n, w in zip(a1, a_wide) if n)
+    # edges and rough calibration
+    assert not any(assigned_to_candidate(i, 0.0) for i in ids)
+    assert all(assigned_to_candidate(i, 1.0) for i in ids)
+    frac = np.mean(a1)
+    assert 0.25 < frac < 0.35
+    assert all(0.0 <= split_key(i) < 1.0 for i in ids)
+
+
+def test_partition_preserves_order_and_covers_batch():
+    from fedamw_tpu.serving import partition
+
+    hit, miss = partition(list(range(10)), lambda x: x % 3 == 0)
+    assert hit == [0, 3, 6, 9] and miss == [1, 2, 4, 5, 7, 8]
+    assert partition([], lambda x: True) == ([], [])
+
+
+def test_format_rollout_report_reads_like_a_verdict():
+    from fedamw_tpu.utils.reporting import format_rollout_report
+
+    line = format_rollout_report({
+        "mode": "shadow", "swaps": 3, "swap_p50_ms": 0.4,
+        "swap_max_ms": 5.6, "canary": "promoted", "canary_ms": 118.8,
+        "rollback_drill": "rolled_back", "inflight_p95_ms": 9.5,
+        "recompiles_during_swaps": 0, "final_version": 3,
+        "staleness_rounds": 1})
+    assert "3 swaps" in line and "canary promoted" in line
+    assert "drill rolled_back" in line and "recompiles 0" in line
+    assert "serving v3" in line
+
+
+# -- rollout: gates, canary, rollback ---------------------------------
+
+def _labels_for(engine, X):
+    return np.argmax(engine.predict(X), -1)
+
+
+def test_parity_gate_failure_rolls_back_and_live_keeps_serving():
+    engine = make_engine()
+    rng = np.random.RandomState(9)
+    X = rng.randn(64, D).astype(np.float32)
+    y = _labels_for(engine, X)  # live model scores 100 on its own labels
+    reg = ModelRegistry()
+    # sign-flipped weights published under the clean model's accuracy:
+    # the gate must catch the lie before any traffic reaches them
+    bad = reg.publish(base_params(-1.0), round_idx=1,
+                      metadata={"eval_acc": 100.0})
+    with ServingService(engine, max_wait_ms=0.5) as svc:
+        ctl = RolloutController(svc, reg, mode="shadow", fraction=0.5,
+                                min_requests=0, parity_data=(X, y))
+        live_before = engine.version
+        assert ctl.stage(bad) is False
+        # prior version serving, candidate fully retired
+        assert engine.version == live_before
+        assert bad not in engine.versions_installed
+        out = svc.predict(X[:4])
+        np.testing.assert_array_equal(out, engine.predict(X[:4]))
+    assert ctl.events[-1]["event"] == "rollback"
+    assert ctl.events[-1]["gate"]["match"] is False
+    assert svc.metrics.rollbacks == 1
+    assert ctl.split() is None
+
+
+def test_shadow_canary_promotes_after_budget_and_answers_from_live():
+    engine = make_engine()
+    rng = np.random.RandomState(11)
+    X = rng.randn(64, D).astype(np.float32)
+    y = _labels_for(engine, X)
+    reg = ModelRegistry()
+    # 2x weights: same argmax (gate passes, agreement 1.0), different
+    # logits (so "answered from live" is distinguishable bitwise)
+    cand = reg.publish(base_params(2.0), round_idx=3,
+                       metadata={"eval_acc": 100.0})
+    payload = X[:4]
+    live_out = engine.predict(payload)
+    with ServingService(engine, max_wait_ms=0.5) as svc:
+        ctl = RolloutController(svc, reg, mode="shadow", fraction=1.0,
+                                min_requests=10, error_budget=0,
+                                min_agreement=0.99, parity_data=(X, y))
+        assert ctl.stage(cand) is True
+        assert engine.version != cand  # staged, not yet live
+        pre = [svc.submit(payload) for _ in range(10)]
+        for f in pre:
+            # shadow phase: every caller answered from the LIVE version
+            # even though its request was mirrored to the candidate
+            out = f.result(timeout=30)
+            if engine.version != cand:  # before the flip lands
+                np.testing.assert_array_equal(out, live_out)
+        deadline = time.perf_counter() + 30
+        while engine.version != cand and time.perf_counter() < deadline:
+            time.sleep(0.005)
+        assert engine.version == cand  # canary promoted
+        post = svc.predict(payload)
+        np.testing.assert_allclose(post, 2 * live_out, rtol=1e-5)
+        snap = svc.metrics.snapshot(engine)
+    assert snap["model_version"] == cand
+    assert snap["weight_swaps"] == 1
+    assert snap["shadow_requests"] >= 10
+    assert snap["candidate_errors"] == 0 and snap["rollbacks"] == 0
+    assert ctl.events[-1]["event"] == "promoted"
+    assert ctl.events[-1]["agreement"] == 1.0
+
+
+class _CandidateFails(ServingEngine):
+    """Candidate-version dispatches raise; live dispatches serve."""
+
+    fail_version = None
+
+    def predict(self, X, version=None):
+        if version is not None and version == self.fail_version:
+            raise RuntimeError("candidate weights exploded")
+        return super().predict(X, version=version)
+
+
+def test_error_budget_rollback_with_live_fallback_in_ab_mode():
+    rng = np.random.RandomState(1)
+    engine = _CandidateFails(base_params(), buckets=(1, 8, 32))
+    engine.warmup()
+    reg = ModelRegistry()
+    cand = reg.publish(base_params(2.0), round_idx=1)
+    engine.fail_version = cand
+    payload = rng.randn(2, D).astype(np.float32)
+    live_out = engine.predict(payload)
+    with ServingService(engine, max_wait_ms=0.5) as svc:
+        ctl = RolloutController(svc, reg, mode="ab", fraction=1.0,
+                                min_requests=1000, error_budget=3)
+        assert ctl.stage(cand) is True
+        futs = [svc.submit(payload) for _ in range(8)]
+        for f in futs:
+            # every A/B caller transparently falls back to the live
+            # version — a broken canary never surfaces as an error
+            np.testing.assert_array_equal(f.result(timeout=30),
+                                          live_out)
+        deadline = time.perf_counter() + 30
+        while ctl.split() is not None and time.perf_counter() < deadline:
+            time.sleep(0.005)
+        snap = svc.metrics.snapshot(engine)
+    assert ctl.split() is None  # rolled back, not promoted
+    assert engine.version != cand
+    assert cand not in engine.versions_installed
+    assert snap["candidate_errors"] > 3
+    assert snap["rollbacks"] == 1
+    assert ctl.events[-1]["event"] == "rollback"
+    assert "error budget" in ctl.events[-1]["reason"]
+
+
+def test_ab_mode_serves_candidate_slice_by_request_id():
+    engine = make_engine()
+    rng = np.random.RandomState(13)
+    reg = ModelRegistry()
+    cand = reg.publish(base_params(2.0), round_idx=1)
+    payload = rng.randn(2, D).astype(np.float32)
+    live_out = engine.predict(payload)
+    with ServingService(engine, max_wait_ms=0.5) as svc:
+        ctl = RolloutController(svc, reg, mode="ab", fraction=0.5,
+                                min_requests=10 ** 6)  # never promotes
+        assert ctl.stage(cand) is True
+        futs = [svc.submit(payload) for _ in range(40)]
+        for f in futs:
+            out = f.result(timeout=30)
+            if assigned_to_candidate(f.request_id, 0.5):
+                np.testing.assert_allclose(out, 2 * live_out, rtol=1e-5)
+            else:
+                np.testing.assert_array_equal(out, live_out)
+        snap = svc.metrics.snapshot(engine)
+    by_ver = snap["requests_by_version"]
+    assert set(by_ver) == {str(engine.version), str(cand)}
+    assert sum(by_ver.values()) == 40
+    ctl.rollback("test done")
+
+
+def test_stage_gate_exception_retires_candidate_and_allows_retry():
+    """A parity gate that cannot RUN (malformed parity data here; a
+    transient backend blip in production) must not leak the installed
+    candidate — the same version number must be re-stageable once the
+    problem clears."""
+    engine = make_engine()
+    rng = np.random.RandomState(9)
+    reg = ModelRegistry()
+    cand = reg.publish(base_params(2.0), round_idx=1,
+                       metadata={"eval_acc": 100.0})
+    bad_width = rng.randn(8, D + 3).astype(np.float32)
+    with ServingService(engine, max_wait_ms=0.5) as svc:
+        ctl = RolloutController(svc, reg, mode="shadow", fraction=0.5,
+                                min_requests=10 ** 6,
+                                parity_data=(bad_width, np.zeros(8)))
+        with pytest.raises(ValueError, match="expected"):
+            ctl.stage(cand)
+        assert cand not in engine.versions_installed  # no leak
+        assert ctl.split() is None
+        # retry with usable parity data: the slot was cleaned up, so
+        # staging the SAME version must not raise "already installed"
+        # (2x weights share the live argmax, so the gate passes)
+        X = rng.randn(64, D).astype(np.float32)
+        ctl.parity_data = (X, _labels_for(engine, X))
+        assert ctl.stage(cand) is True
+        ctl.rollback("test done")
+
+
+def test_snapshot_staleness_tracks_registry_after_swaps_stop():
+    """The falling-behind signal: once promoted, a service that never
+    swaps again must still watch its staleness grow as training
+    publishes new rounds."""
+    engine = make_engine()
+    rng = np.random.RandomState(11)
+    X = rng.randn(64, D).astype(np.float32)
+    y = _labels_for(engine, X)
+    reg = ModelRegistry()
+    cand = reg.publish(base_params(2.0), round_idx=3,
+                       metadata={"eval_acc": 100.0})
+    with ServingService(engine, max_wait_ms=0.5) as svc:
+        ctl = RolloutController(svc, reg, mode="shadow", fraction=0.5,
+                                min_requests=0, parity_data=(X, y))
+        assert ctl.stage(cand) and engine.version == cand
+        assert svc.metrics.snapshot(engine)["staleness_rounds"] == 0
+        reg.publish(base_params(3.0), round_idx=10)  # training moves on
+        snap = svc.metrics.snapshot(engine)
+    assert snap["staleness_rounds"] == 7  # live at read time, not swap
+
+
+def test_registry_seeded_engine_reports_staleness_before_any_swap(
+        tmp_path):
+    """The never-swapped window: an engine seeded with its REGISTRY
+    version (the documented load(version=) flow) watches itself fall
+    behind as training publishes, before any rollout ever runs."""
+    from fedamw_tpu.utils.checkpoint import save_checkpoint
+
+    save_checkpoint(str(tmp_path / "ck"), base_params(), round_idx=2,
+                    extra={"eval_acc": 50.0})
+    reg = ModelRegistry()
+    live_v = reg.publish_checkpoint(str(tmp_path / "ck"))
+    engine = ServingEngine.load(str(tmp_path / "ck"), buckets=(1, 8),
+                                version=live_v)
+    engine.warmup()
+    with ServingService(engine, max_wait_ms=0.5) as svc:
+        RolloutController(svc, reg, mode="shadow", fraction=0.5,
+                          min_requests=10 ** 6)
+        assert svc.metrics.snapshot(engine)["staleness_rounds"] == 0
+        reg.publish(base_params(2.0), round_idx=9)
+        snap = svc.metrics.snapshot(engine)
+    assert snap["model_version"] == live_v
+    assert snap["staleness_rounds"] == 7  # behind, with zero swaps
+
+
+def test_second_concurrent_stage_is_refused():
+    engine = make_engine()
+    reg = ModelRegistry()
+    v1 = reg.publish(base_params(2.0), round_idx=1)
+    v2 = reg.publish(base_params(3.0), round_idx=2)
+    with ServingService(engine, max_wait_ms=0.5) as svc:
+        ctl = RolloutController(svc, reg, mode="shadow", fraction=0.5,
+                                min_requests=10 ** 6)
+        assert ctl.stage(v1)
+        with pytest.raises(RuntimeError, match="in flight"):
+            ctl.stage(v2)
+        ctl.rollback("test done")
+        assert ctl.stage(v2)  # slot free again after rollback
+        ctl.rollback("test done")
+
+
+def test_continuous_promote_loop_bounds_installed_versions():
+    """The headline long-lived scenario: one stage->promote per
+    published round. The engine must hold at most live + one prior
+    (for revert) on device — never every version it ever served."""
+    engine = make_engine()
+    reg = ModelRegistry()
+    X = np.random.RandomState(5).randn(2, D).astype(np.float32)
+    with ServingService(engine, max_wait_ms=0.5) as svc:
+        ctl = RolloutController(svc, reg, mode="shadow", fraction=0.5,
+                                min_requests=0)  # direct verified deploy
+        for k in range(1, 6):
+            v = reg.publish(base_params(float(k + 1)), round_idx=k)
+            assert ctl.stage(v)
+            assert engine.version == v
+            assert len(engine.versions_installed) <= 2
+        out = svc.predict(X)
+    # prior kept for revert, everything older retired
+    assert engine.versions_installed == [4, 5]
+    np.testing.assert_allclose(out, engine.predict(X, version=5))
+    prev = ctl.revert()
+    assert prev == 4 and engine.version == 4
+    # the reverted-away version is retired (the memory bound holds
+    # through reverts) and the one-shot prior slot is consumed
+    assert engine.versions_installed == [4]
+    with pytest.raises(RuntimeError, match="prior"):
+        ctl.revert()
+
+
+def test_swap_explicit_version_refuses_installed_slot():
+    engine = make_engine()
+    engine.install_weights(3, base_params(5.0))
+    with pytest.raises(ValueError, match="already installed"):
+        engine.swap_weights(base_params(2.0), version=3)
+    # the staged slot is untouched and auto-assign still works
+    X = np.random.RandomState(5).randn(2, D).astype(np.float32)
+    base_out = engine.predict(X)
+    np.testing.assert_allclose(engine.predict(X, version=3),
+                               5 * base_out, rtol=1e-5)
+    assert engine.swap_weights(base_params(2.0)) == 4
+
+
+# -- observability: version/staleness on every span -------------------
+
+def test_every_request_span_carries_version_and_staleness():
+    engine = make_engine()
+    rng = np.random.RandomState(17)
+    X = rng.randn(64, D).astype(np.float32)
+    y = _labels_for(engine, X)
+    reg = ModelRegistry()
+    reg.publish(base_params(), round_idx=1)  # makes v0 stale by publish
+    cand = reg.publish(base_params(2.0), round_idx=4,
+                       metadata={"eval_acc": 100.0})
+    tracer = Tracer()
+    payload = X[:2]
+    with ServingService(engine, max_wait_ms=0.5, tracer=tracer) as svc:
+        ctl = RolloutController(svc, reg, mode="shadow", fraction=0.5,
+                                min_requests=0, parity_data=(X, y))
+        n_before = 6
+        for _ in range(n_before):
+            svc.predict(payload)
+        ctl.stage(cand)  # min_requests=0: immediate verified deploy
+        assert engine.version == cand
+        for _ in range(6):
+            svc.predict(payload)
+        # a deadline-shed request must carry the dimensions too
+        dead = svc.submit(payload, timeout_s=0.0)
+        with pytest.raises(Exception):
+            dead.result(timeout=30)
+    spans = [r for r in tracer.records() if r["name"] == "request"]
+    assert len(spans) == 13
+    for s in spans:
+        assert "model_version" in s["attrs"], s
+        assert "staleness_rounds" in s["attrs"], s
+        assert s["attrs"]["staleness_rounds"] >= 0
+    served_by = {s["attrs"]["model_version"] for s in spans
+                 if s["attrs"]["outcome"] == "ok"}
+    assert cand in served_by  # post-swap traffic attributed to it
+    # the promoted candidate is the newest publish: staleness 0
+    post = [s for s in spans if s["attrs"]["model_version"] == cand]
+    assert all(s["attrs"]["staleness_rounds"] == 0 for s in post)
+    snap = svc.metrics.snapshot(engine)
+    assert snap["model_version"] == cand
+    assert snap["staleness_rounds"] == 0
+
+
+# -- atomicity --------------------------------------------------------
+
+def test_swap_atomic_under_concurrent_submit_zero_recompiles():
+    """Rapid swaps against concurrent submitters: every result must be
+    EXACTLY one installed version's output — params and rff of
+    different versions can never mix (versions differ in BOTH, so any
+    torn read would produce an output matching neither) — and the
+    compiled ladder never grows."""
+    rng = np.random.RandomState(2)
+    W = rng.randn(8, D).astype(np.float32)
+    b = rng.randn(D).astype(np.float32)
+    params = {"w": rng.randn(C, D).astype(np.float32)}
+    engine = ServingEngine(params, rff=(W, b), buckets=(1, 8))
+    engine.warmup()
+    X = rng.randn(4, 8).astype(np.float32)
+    # version k: params scaled by (k+1) AND a shifted rff offset
+    for k in (1, 2, 3):
+        engine.install_weights(
+            k, {"w": (k + 1.0) * params["w"]}, rff=(W, b + k))
+    expected = {k: engine.predict(X, version=k) for k in (0, 1, 2, 3)}
+    cc = engine.compile_count
+    stop = threading.Event()
+    failures: list = []
+
+    def swapper():
+        k = 0
+        while not stop.is_set():
+            engine.swap_weights(version=k % 4)
+            k += 1
+
+    with ServingService(engine, max_wait_ms=0.2) as svc:
+        th = threading.Thread(target=swapper)
+        th.start()
+        try:
+            futs = [svc.submit(X) for _ in range(200)]
+            for f in futs:
+                out = f.result(timeout=60)
+                if not any(np.array_equal(out, e)
+                           for e in expected.values()):
+                    failures.append(out)
+        finally:
+            stop.set()
+            th.join()
+    assert not failures, (
+        f"{len(failures)} results matched NO installed version — "
+        "a torn params/rff read escaped the swap lock")
+    assert engine.compile_count == cc
+
+
+class _FailOnce(ServingEngine):
+    """First dispatch raises a transient error; later ones serve."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.fail_next = False
+
+    def predict(self, X, version=None):
+        if self.fail_next:
+            self.fail_next = False
+            raise ConnectionError("remote tunnel blip")
+        return super().predict(X, version=version)
+
+
+def test_retry_re_resolves_live_version_across_a_swap():
+    """A request queued against version k whose dispatch hits a
+    transient failure, with a hot swap landing during the retry
+    backoff, must be answered by the NEW live version — the retry
+    re-resolves instead of dispatching against a half-swapped
+    engine."""
+    rng = np.random.RandomState(3)
+    engine = _FailOnce(base_params(), buckets=(1, 8))
+    engine.warmup()
+    X = rng.randn(2, D).astype(np.float32)
+    out_old = engine.predict(X)
+    with ServingService(engine, max_wait_ms=0.2, retries=2,
+                        retry_backoff_ms=150.0) as svc:
+        engine.fail_next = True
+        fut = svc.submit(X)
+        time.sleep(0.03)  # let the worker dispatch, fail, start backoff
+        engine.swap_weights(base_params(2.0))  # swap DURING the backoff
+        out = fut.result(timeout=60)
+    np.testing.assert_allclose(out, 2 * out_old, rtol=1e-5)
+    assert svc.metrics.retries == 1
+    assert svc.metrics.requests_retried == 1

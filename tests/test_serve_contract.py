@@ -10,9 +10,14 @@ serving/evaluate accuracy parity; the ISSUE 5 trace plane — per-stage
 (queue/pad/device) percentile families in the mixed-stream snapshot,
 a trace section holding every submitted request id exactly once, the
 phases breakdown, and the serve_trace_overhead line before the
-headline; and the strict-backend guard — BENCH_STRICT_TPU must abort
-rc=1 on a leaked CPU backend BEFORE measuring anything, exactly like
-bench.py, so a CPU capture can never be harvested as TPU evidence.
+headline; the ISSUE 6 rollout leg — >= 3 hot swaps with zero
+recompiles, a promoted shadow canary, a parity-failure rollback
+drill, model_version/staleness_rounds dimensions in the snapshot and
+in every request span, and the rollout leg's spans STREAMED through
+rotating JSONL parts; and the strict-backend guard — BENCH_STRICT_TPU
+must abort rc=1 on a leaked CPU backend BEFORE measuring anything,
+exactly like bench.py, so a CPU capture can never be harvested as TPU
+evidence.
 """
 
 import json
@@ -71,10 +76,23 @@ def test_serve_bench_emits_driver_contract_json(tmp_path):
     # stability) landed exactly one span
     assert trace_lines[0]["request_spans"] == 200
 
+    # ISSUE 6 pins — the rollout line prints before the trace-overhead
+    # line (headline still LAST): swaps took, the shadow canary
+    # promoted, the parity drill rolled back, and the zero-recompile
+    # pin covers the swapped streams
+    roll_lines = [l for l in lines if l["metric"] == "serve_rollout"]
+    assert len(roll_lines) == 1 and roll_lines[0] == lines[-3]
+    roll = roll_lines[0]
+    assert roll["swaps"] >= 3
+    assert roll["canary"] == "promoted"
+    assert roll["rollback_drill"] == "rolled_back"
+    assert roll["recompiles_during_swaps"] == 0
+    assert roll["value"] > 0  # swap p50 ms
+
     # the artifact mirrors the lines and carries the parity verdict
     with open(out_path) as f:
         art = json.load(f)
-    assert art["schema"] == "BENCH_SERVE.v1"
+    assert art["schema"] == "BENCH_SERVE.v2"
     assert art["recompiles_after_warmup"] == 0
     assert len(art["bucket_latency"]) >= 3
     assert art["parity"]["match"] is True
@@ -107,6 +125,30 @@ def test_serve_bench_emits_driver_contract_json(tmp_path):
     for k in ("build_s", "compile_warmup_s", "timed_run_s"):
         assert art["phases"][k] >= 0
 
+    # the rollout section: the continuous-deployment evidence the v2
+    # schema requires (tools/check_bench_schema.py gates it)
+    rollout = art["rollout"]
+    assert rollout["swaps"] >= 3
+    assert rollout["swap_p50_ms"] > 0
+    assert rollout["inflight_p95_ms"] > 0
+    assert rollout["recompiles_during_swaps"] == 0
+    assert rollout["canary"] == "promoted"
+    assert rollout["rollback_drill"] == "rolled_back"
+    assert rollout["drill_gate"]["checked"] is True
+    assert rollout["drill_gate"]["match"] is False  # the lie was caught
+    assert rollout["shadow_requests"] > 0
+    assert rollout["rollbacks"] == 1  # exactly the drill
+    assert rollout["final_version"] >= 3
+    # the drill's rejected publish is withdrawn, so a green run ends
+    # serving the newest SERVABLE model: zero staleness
+    assert rollout["staleness_rounds"] == 0
+    assert art["phases"]["rollout_s"] >= 0
+    # the mixed stream predates any swap: served by the seed version,
+    # zero staleness, and the new dimensions are present
+    assert stream["model_version"] == 0
+    assert stream["staleness_rounds"] == 0
+    assert stream["weight_swaps"] == 0
+
     # SERVE_TRACE exported the traced leg's spans as readable JSONL
     from fedamw_tpu.utils.trace import read_jsonl
 
@@ -115,6 +157,23 @@ def test_serve_bench_emits_driver_contract_json(tmp_path):
     header, spans = read_jsonl(art["trace"]["exported"])
     req_ids = [s["trace_id"] for s in spans if s["name"] == "request"]
     assert len(req_ids) == len(set(req_ids)) == 200
+    # every span of the traced stream carries the rollout dimensions
+    for s in spans:
+        if s["name"] == "request":
+            assert "model_version" in s["attrs"]
+            assert "staleness_rounds" in s["attrs"]
+
+    # the rollout leg STREAMED its spans (rotating parts, in-memory
+    # collector bypassed) into the same SERVE_TRACE directory
+    parts = sorted(p for p in os.listdir(trace_dir)
+                   if p.startswith("serve_loop-"))
+    assert len(parts) == rollout["trace_parts"] >= 1
+    streamed = 0
+    for p in parts:
+        h, ss = read_jsonl(os.path.join(trace_dir, p))
+        assert h["streaming"] is True
+        streamed += len(ss)
+    assert streamed == rollout["trace_spans"] > 0
 
 
 def test_serve_strict_tpu_refuses_cpu_backend(tmp_path):

@@ -131,6 +131,112 @@ def test_jsonl_schema_round_trip(tmp_path):
         read_jsonl(str(other))
 
 
+# -- streaming (rotating JSONL writer) --------------------------------
+
+def test_streaming_writer_rotates_and_keeps_collector_empty(tmp_path):
+    """The long-lived-loop mode (ISSUE 6 satellite): spans stream to
+    rotating part files — each standalone-readable with the schema
+    header, nothing lost at rotation boundaries — while the in-memory
+    collector stays EMPTY (the unbounded-growth fix)."""
+    from fedamw_tpu.utils.trace import RotatingJsonlWriter
+
+    w = RotatingJsonlWriter(str(tmp_path / "stream"),
+                            max_spans_per_file=10)
+    tr = Tracer(writer=w)
+    ids = [tr.emit("request", f"req-{i}", float(i), 0.1, outcome="ok")
+           for i in range(25)]
+    assert all(ids)  # streaming spans still get ids
+    assert len(tr) == 0 and tr.dropped == 0  # nothing buffered
+    # per-span flush: a tailing shipper (or a crash) sees every span
+    # already on disk BEFORE close
+    _, live_spans = read_jsonl(w.paths[-1])
+    assert len(live_spans) == 5
+    w.close()
+    assert w.spans_written == 25
+    assert len(w.paths) == 3  # 10 + 10 + 5
+    seen = []
+    for path in w.paths:
+        header, spans = read_jsonl(path)
+        assert header["schema"] == TRACE_SCHEMA
+        assert header["streaming"] is True
+        assert len(spans) <= 10
+        for s in spans:
+            assert set(s) == set(SPAN_FIELDS)
+        seen += [s["trace_id"] for s in spans]
+    assert seen == [f"req-{i}" for i in range(25)]  # exactly once, ordered
+    # a streaming tracer refuses the buffered-export spelling (the
+    # spans are already on disk; silently writing 0 would look green)
+    with pytest.raises(ValueError, match="streaming"):
+        tr.export_jsonl(str(tmp_path / "nope.jsonl"))
+    # writing after close is loud, not a silent drop
+    with pytest.raises(ValueError, match="closed"):
+        w.write(dict(zip(SPAN_FIELDS, ["n", "span", "t", "s", None,
+                                       0.0, 0.0, {}])))
+    # even when closed BEFORE the first span (lazy open must not
+    # silently resurrect a closed writer)
+    w2 = RotatingJsonlWriter(str(tmp_path / "early"))
+    w2.close()
+    with pytest.raises(ValueError, match="closed"):
+        w2.write(dict(zip(SPAN_FIELDS, ["n", "span", "t", "s", None,
+                                        0.0, 0.0, {}])))
+    assert w2.paths == []
+    # a SUPERSEDED tracer (writer closed by a reconfigure while some
+    # thread still holds it) degrades to counted drops, never raises
+    # into the emitting thread
+    assert tr.emit("late", "t-late", 0.0, 1.0) is None
+    assert tr.dropped == 1
+
+
+def test_streaming_writer_restart_never_truncates_prior_parts(tmp_path):
+    """The crash-restart case the per-span flush exists for: a new
+    writer pointed at a directory holding a previous run's parts must
+    number PAST them, never reopen (and truncate) part 1."""
+    from fedamw_tpu.utils.trace import RotatingJsonlWriter
+
+    w1 = RotatingJsonlWriter(str(tmp_path), max_spans_per_file=5)
+    t1 = Tracer(writer=w1)
+    for i in range(7):
+        t1.emit("s", f"run1-{i}", 0.0, 1.0)
+    # no close(): simulate an OOM-killed process (flush already wrote)
+    w2 = RotatingJsonlWriter(str(tmp_path), max_spans_per_file=5)
+    t2 = Tracer(writer=w2)
+    t2.emit("s", "run2-0", 0.0, 1.0)
+    w2.close()
+    assert not (set(w1.paths) & set(w2.paths))
+    _, first_run_spans = read_jsonl(w1.paths[0])
+    assert [s["trace_id"] for s in first_run_spans] == \
+        [f"run1-{i}" for i in range(5)]  # prior run intact
+    _, new_spans = read_jsonl(w2.paths[0])
+    assert new_spans[0]["trace_id"] == "run2-0"
+
+
+def test_streaming_writer_concurrent_writes_lose_nothing(tmp_path):
+    from fedamw_tpu.utils.trace import RotatingJsonlWriter
+
+    w = RotatingJsonlWriter(str(tmp_path), max_spans_per_file=50)
+    tr = Tracer(writer=w)
+    n_threads, per = 8, 100
+
+    def emit(k):
+        for i in range(per):
+            tr.emit("s", f"t{k}-{i}", 0.0, 1.0)
+
+    threads = [threading.Thread(target=emit, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    w.close()
+    assert w.spans_written == n_threads * per
+    all_ids = []
+    for path in w.paths:
+        _, spans = read_jsonl(path)
+        all_ids += [s["trace_id"] for s in spans]
+    assert len(all_ids) == n_threads * per
+    assert len(set(all_ids)) == n_threads * per  # exactly once each
+
+
 # -- thread-safety ----------------------------------------------------
 
 def test_concurrent_emitters_lose_nothing():

@@ -22,7 +22,13 @@ Three artifact families, three rule sets:
   ``schema`` in the ``BENCH_SERVE.`` family, a top-level ``platform``
   label, a non-empty per-bucket latency table, a mixed-stream section
   with a positive request count, and the ``recompiles_after_warmup``
-  field the zero-recompile pin reads.
+  field the zero-recompile pin reads. From schema v2 on, the
+  ``rollout`` section (the ISSUE 6 continuous-deployment leg) is also
+  required: swap count and latency, in-flight p95 across swaps, the
+  canary/rollback-drill verdicts, and zero recompiles during swaps —
+  v1 artifacts (r01) predate the leg and are grandfathered by schema
+  version, so the rule stays strict for every artifact that could
+  carry it.
 - ``MULTICHIP_rNN.json`` — the dryrun wrapper: ``n_devices``/``rc``/
   ``ok``/``tail``, with ``ok`` true iff ``rc == 0`` (a disagreeing
   pair is exactly the silent-green failure this tool exists to catch).
@@ -130,6 +136,53 @@ def check_serve_artifact(art: dict, name: str) -> list[str]:
     if not isinstance(art.get("recompiles_after_warmup"), int):
         errs.append("missing 'recompiles_after_warmup' (the "
                     "zero-recompile pin reads it)")
+    errs.extend(_check_rollout_section(art, schema))
+    return errs
+
+
+def _check_rollout_section(art: dict, schema: str) -> list[str]:
+    """The v2+ ``rollout`` contract (the continuous-deployment leg):
+    the driver reads swap latency, the in-flight tail across swaps,
+    the canary and rollback-drill verdicts, and the swaps-recompile
+    pin. v1 artifacts predate the leg (grandfathered by schema
+    version, like the BENCH_ platform label by capture number)."""
+    if not schema.startswith("BENCH_SERVE."):
+        return []  # family error already reported by the caller
+    try:
+        version = int(schema.rsplit(".v", 1)[1])
+    except (IndexError, ValueError):
+        # 'BENCH_SERVE.v2-rc1' etc. would otherwise skip the v2 rules
+        # entirely — the silent-green landing this gate exists to stop
+        return [f"unparseable schema version {schema!r} "
+                "(expected BENCH_SERVE.vN)"]
+    if version < 2:
+        return []
+    rollout = art.get("rollout")
+    if not isinstance(rollout, dict):
+        return ["schema v2+ requires a 'rollout' section (the "
+                "continuous-deployment leg)"]
+    errs = []
+    if not isinstance(rollout.get("swaps"), int) or rollout["swaps"] < 1:
+        errs.append("rollout: 'swaps' must be a positive int")
+    for key in ("swap_p50_ms", "inflight_p95_ms"):
+        if not isinstance(rollout.get(key), (int, float)):
+            errs.append(f"rollout: missing numeric {key!r}")
+    if not isinstance(rollout.get("recompiles_during_swaps"), int):
+        errs.append("rollout: missing int 'recompiles_during_swaps' "
+                    "(the hot-swap zero-recompile pin reads it)")
+    for key in ("canary", "rollback_drill"):
+        verdict = rollout.get(key)
+        if not isinstance(verdict, str) or not verdict:
+            errs.append(f"rollout: missing {key!r} verdict")
+        elif verdict == "FAILED":
+            # the bench aborts on these; an artifact carrying one is
+            # exactly the silent-green failure this tool catches
+            errs.append(f"rollout: {key} == 'FAILED' must never land "
+                        "in a committed artifact")
+    if "final_version" not in rollout \
+            or not isinstance(rollout.get("staleness_rounds"), int):
+        errs.append("rollout: missing 'final_version'/"
+                    "'staleness_rounds' dimensions")
     return errs
 
 
