@@ -623,13 +623,17 @@ def _run_segmented(algo_fn, name, setup, publish_every, R, rff,
                        ("train_loss", "test_loss", "test_acc")})
         state = {k: res[k] for k in ("params", "p", "p_opt",
                                      "server_opt", "server_opt_kind",
-                                     "reputation") if k in res}
+                                     "reputation", "zq") if k in res}
         final_path = os.path.join(base, f"v{k1:04d}")
         where = save_checkpoint(
             final_path, res["params"],
             p=res["p"], round_idx=k1, extra=_ckpt_extra(res), rff=rff,
             feature_dtype=feat_dtype,
-            reputation=res.get("reputation"))
+            reputation=res.get("reputation"),
+            # the quarantine:auto threshold estimate rides alongside
+            # reputation: a resumed segment keeps the tuned threshold
+            # instead of re-tuning from Z=5
+            defense_state=({"zq": res["zq"]} if "zq" in res else None))
         print(f"{name}: published round-{k1} model -> {where}")
     out = dict(res)
     for key in ("train_loss", "test_loss", "test_acc"):
@@ -826,6 +830,10 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete,
                     # resume must not restart a quarantined attacker
                     # at full trust
                     reputation=res.get("reputation"),
+                    # quarantine:auto's carried threshold estimate —
+                    # resume must not re-tune from the Z=5 start
+                    defense_state=({"zq": res["zq"]}
+                                   if "zq" in res else None),
                 )
                 print(f"{name}: checkpoint -> {where}")
         print(f"[repeat {t}] wall time {time.time() - t0:.1f}s "

@@ -304,6 +304,12 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                                     dstate["zq"])
                     new_state["zq"] = ((1.0 - Z_AUTO_BETA) * dstate["zq"]
                                        + Z_AUTO_BETA * q_t)
+                    # the carried estimate itself rides the metric
+                    # stream so return_state can hand the FINAL value
+                    # to a checkpoint (z_threshold above is the
+                    # derived, clipped threshold — not invertible back
+                    # to zq, so the raw carry must flow out too)
+                    aux["zq"] = new_state["zq"]
                 present = present * zok
         if rep_on:
             dir_cos = directional_scores(params, stacked, present)
@@ -354,7 +360,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
         def train(seed, X, y, idx, mask, X_val, y_val,
                   X_test, y_test, lrs, p0, sizes, mu, lam,
                   params0=None, p_opt0=None, fault_rows=None,
-                  rep0=None):
+                  rep0=None, zq0=None):
             keys, params = prologue(seed)
             if params0 is not None:  # resume / warm start
                 params = params0
@@ -368,6 +374,11 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 # trust (a quarantined attacker must not be re-trusted
                 # by a preemption)
                 dstate0["rep"] = rep0
+            if zq0 is not None and "zq" in dstate0:
+                # resume: quarantine:auto's threshold estimate
+                # continues from the checkpoint instead of re-tuning
+                # from the Z=5 start (the ROADMAP carried follow-on)
+                dstate0["zq"] = zq0
             if p_opt0 is not None:
                 # resume: the p-optimizer momentum buffer, shipped as a
                 # flat leaf tuple (checkpoint formats don't preserve
@@ -510,7 +521,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
     @jax.jit
     def train(seed, X, y, idx, mask, X_test, y_test, lrs,
               p_fixed, sizes, mu, lam, params0=None, server_opt0=None,
-              fault_rows=None, rep0=None):
+              fault_rows=None, rep0=None, zq0=None):
         keys, params = prologue(seed)
         if params0 is not None:  # resume / warm start
             params = params0
@@ -625,6 +636,10 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
             # resume: see the learned path — the reputation carry
             # continues from the checkpoint, not from full trust
             dstate0["rep"] = rep0
+        if zq0 is not None and "zq" in dstate0:
+            # resume: the auto-threshold estimate continues (learned
+            # path comment)
+            dstate0["zq"] = zq0
         (params, opt_state, _dstate), metrics = jax.lax.scan(
             body, (params, opt_state0, dstate0), tuple(xs)
         )
@@ -1068,6 +1083,34 @@ def _round_based(
                     f"this run's cohort needs ({setup.num_clients},) — "
                     "resuming across a cohort change is undefined")
 
+    # the quarantine:auto threshold estimate resumes the same way: a
+    # checkpoint's defense_state carries the carried zq (the running
+    # clean-z quantile), so a resumed run keeps the tuned threshold
+    # instead of re-tuning from the Z=5 start (the carried ROADMAP
+    # follow-on). Accepted from either a checkpoint's 'defense_state'
+    # dict or an in-memory result's top-level 'zq' (return_state).
+    zq0 = None
+    if resume_from is not None and parse_robust_spec(
+            robust_agg).zscore_auto:
+        saved_ds = resume_from.get("defense_state") or {}
+        zq_saved = saved_ds.get("zq", resume_from.get("zq"))
+        if zq_saved is None:
+            warnings.warn(
+                "resuming a quarantine:auto run from a checkpoint "
+                "without a 'zq' defense state: the auto threshold "
+                "re-tunes from the Z=5 start instead of continuing the "
+                "carried estimate (save with return_state=True and "
+                "pass res['zq'] through save_checkpoint("
+                "defense_state={'zq': ...}) — exp.py --save_models "
+                "does)", stacklevel=3)
+        else:
+            zq_arr = np.asarray(zq_saved, np.float32)
+            if zq_arr.size != 1:
+                raise ValueError(
+                    f"checkpoint 'zq' must be a scalar threshold "
+                    f"estimate, got shape {zq_arr.shape}")
+            zq0 = jnp.asarray(zq_arr.reshape(()), jnp.float32)
+
     # the plan rows ride the dispatch like the LR schedule: sliced from
     # the full horizon, so prefix + resume replays identical faults
     fault_rows = plan.rows(start_round, stop) if faults_on else None
@@ -1075,12 +1118,12 @@ def _round_based(
         args = (seed, setup.X, setup.y, idx_tup, mask_tup,
                 setup.X_val, setup.y_val, setup.X_test, setup.y_test,
                 lrs, p0, setup.sizes, float(mu), float(lam), params0,
-                opt0, fault_rows, rep0)
+                opt0, fault_rows, rep0, zq0)
     else:
         args = (seed, setup.X, setup.y, idx_tup, mask_tup,
                 setup.X_test, setup.y_test, lrs,
                 p0, setup.sizes, float(mu), float(lam), params0, opt0,
-                fault_rows, rep0)
+                fault_rows, rep0, zq0)
 
     if analyze_memory:
         # AOT device-memory report for the WHOLE fused training program
@@ -1174,6 +1217,11 @@ def _round_based(
             # checkpointable so a resumed run continues the trust
             # state instead of restarting at full trust
             out["reputation"] = metrics["reputation"][-1]
+        if "zq" in metrics:
+            # the FINAL quarantine:auto threshold estimate — the same
+            # carry-to-checkpoint contract as reputation (save via
+            # save_checkpoint(defense_state={'zq': res['zq']}))
+            out["zq"] = metrics["zq"][-1]
     return out
 
 

@@ -8,28 +8,45 @@ for zero-recompile hot swaps), ``batcher`` (dynamic micro-batching),
 shedding, and rollout-aware traffic splitting), ``metrics`` (latency
 percentiles / throughput / shed counters / model-version + staleness
 dimensions), ``registry`` (versioned model store closing the
-train->serve loop), ``rollout`` (shadow/A-B canary controller with
-parity gate, error budget, and automatic rollback). Driven by
+train->serve loop, plus a checkpoint-watching publisher thread),
+``rollout`` (shadow/A-B canary controller with parity gate, error
+budget, and automatic rollback), ``replica``/``chaos`` (N replicas over
+one compiled ladder behind a health-gating failover router with
+dead-replica requeue and hedged dispatch, proven under seeded
+deterministic chaos). Driven by
 ``serve_bench.py`` at the repo root, which emits ``BENCH_SERVE_*.json``
 in the ``bench.py`` schema family with the same strict-backend guard.
 """
 
 from .batcher import MicroBatcher, coalesce, drain, partition, split_results
+from .chaos import ChaosFault, ChaosPlan, ChaosSpec, resolve_chaos_plan
 from .engine import DEFAULT_BUCKETS, ServingEngine, bucket_for, infer_model
 from .metrics import LatencyHistogram, ServeMetrics
-from .registry import ModelRegistry, ModelVersion
+from .registry import CheckpointWatcher, ModelRegistry, ModelVersion
+from .replica import (FailoverRouter, NoReplicasAvailable, Replica,
+                      ReplicaDead, ReplicaSet, ReplicaUnavailable)
 from .rollout import RolloutController, assigned_to_candidate, split_key
 from .service import (DeadlineExceeded, Overloaded, ServiceStopped,
                       ServingService)
 
 __all__ = [
+    "ChaosFault",
+    "ChaosPlan",
+    "ChaosSpec",
+    "CheckpointWatcher",
     "DEFAULT_BUCKETS",
     "DeadlineExceeded",
+    "FailoverRouter",
     "LatencyHistogram",
     "MicroBatcher",
     "ModelRegistry",
     "ModelVersion",
+    "NoReplicasAvailable",
     "Overloaded",
+    "Replica",
+    "ReplicaDead",
+    "ReplicaSet",
+    "ReplicaUnavailable",
     "RolloutController",
     "ServeMetrics",
     "ServiceStopped",
@@ -41,6 +58,7 @@ __all__ = [
     "drain",
     "infer_model",
     "partition",
+    "resolve_chaos_plan",
     "split_key",
     "split_results",
 ]

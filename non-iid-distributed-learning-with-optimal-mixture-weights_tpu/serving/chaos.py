@@ -1,0 +1,287 @@
+"""Deterministic chaos injection for the serving replica fleet.
+
+The serving twin of ``fedcore/faults.py``: training proves its defenses
+under a seeded :class:`~fedcore.faults.FaultPlan`, and the failover
+layer (``serving/replica.py``) must be proven the same way — under a
+schedule of replica deaths and stalls that is **reproducible**, not
+hoped for. A :class:`ChaosSpec` (parsed from the CLI-style string
+syntax below) expands once, host-side, into a :class:`ChaosPlan` — a
+dense ``(n_replicas, horizon)`` role matrix seeded by the spec, so the
+same seed always yields the same kill/wedge/flaky/slow schedule. The
+plan is consulted at the **engine-dispatch boundary**
+(``Replica.predict``), which is where real failures happen: the batch
+was formed, the request was routed, and then the replica died under it.
+
+Chaos kinds (mutually exclusive per ``(replica, dispatch)`` cell,
+sampled from one uniform draw — kill wins over wedge over flaky over
+slow, mirroring the fault plane's role precedence):
+
+- **kill**: the replica dies on this dispatch and STAYS dead — this
+  dispatch and every later one raise ``ReplicaDead``. The router must
+  re-queue the in-flight batch against survivors.
+- **wedge**: the dispatch stalls for ``wedge_s`` seconds (a hung
+  backend — long enough to blow a typical request deadline) and then
+  fails transiently. A hedging router masks the stall by mirroring to
+  a second replica at the latency threshold.
+- **flaky**: the dispatch fails immediately with a transient error
+  (:class:`ChaosFault` is a ``ConnectionError``, so the service's
+  transient-retry classifier treats it exactly like a real tunnel
+  blip).
+- **slow**: the dispatch succeeds but takes ``slow_mult`` times as
+  long (the real work plus a proportional stall) — the health plane's
+  EWMA latency must steer traffic away from it.
+
+Spec string syntax (mirrors the ``faults=`` grammar)::
+
+    kill=0.01,wedge=0.02:0.25,flaky=0.05,slow=0.1:3.0,seed=7
+         ^rate       ^rate ^stall_s   ^rate      ^rate ^multiplier
+
+Rates are per (replica, dispatch) cell. Past the plan ``horizon``
+(default 4096 dispatches per replica) every cell is clean — a bounded
+experiment, not an unbounded hazard. For exact placement (the bench
+kills replica 1 on its 25th dispatch, mid-stream, every run),
+:meth:`ChaosPlan.scripted` builds the cells explicitly instead of by
+rate; both constructions are plain data and fully deterministic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+#: Role codes in the plan matrix (int8). CLEAN must be 0 so a
+#: zero-initialized matrix is the clean plan.
+CLEAN, KILL, WEDGE, FLAKY, SLOW = 0, 1, 2, 3, 4
+
+_ROLE_NAMES = {CLEAN: "clean", KILL: "kill", WEDGE: "wedge",
+               FLAKY: "flaky", SLOW: "slow"}
+
+
+class ChaosFault(ConnectionError):
+    """An injected TRANSIENT dispatch failure (flaky / post-stall
+    wedge). Subclasses ``ConnectionError`` on purpose: the service's
+    transient classifier (``service._is_transient``) must treat
+    injected chaos exactly like the real connectivity failures it
+    stands in for — no chaos-aware special case anywhere downstream."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosSpec:
+    """Rates and shapes of the chaos to inject, plus the plan seed."""
+
+    kill: float = 0.0
+    wedge: float = 0.0
+    wedge_s: float = 0.25
+    flaky: float = 0.0
+    slow: float = 0.0
+    slow_mult: float = 3.0
+    seed: int = 0
+
+    def __post_init__(self):
+        for name in ("kill", "wedge", "flaky", "slow"):
+            r = getattr(self, name)
+            if not 0.0 <= r <= 1.0:
+                raise ValueError(
+                    f"chaos rate {name}={r} must be in [0, 1]")
+        total = self.kill + self.wedge + self.flaky + self.slow
+        if total > 1.0:
+            raise ValueError(
+                f"chaos rates must sum to <= 1 (a dispatch is at most "
+                f"one of kill/wedge/flaky/slow), got "
+                f"kill+wedge+flaky+slow={total}")
+        if not (np.isfinite(self.wedge_s) and self.wedge_s > 0):
+            raise ValueError(
+                f"wedge_s={self.wedge_s} must be a positive stall "
+                "(seconds the wedged dispatch hangs before failing)")
+        if not (np.isfinite(self.slow_mult) and self.slow_mult >= 1.0):
+            raise ValueError(
+                f"slow_mult={self.slow_mult} must be >= 1 (the latency "
+                "multiplier of a slow dispatch)")
+
+    @classmethod
+    def parse(cls, text: str) -> "ChaosSpec":
+        """Parse the spec syntax (module docstring). Unknown keys and
+        malformed values raise ``ValueError`` naming the token — same
+        fail-at-the-flag-boundary contract as ``FaultSpec.parse``."""
+        kw: dict = {}
+        for token in text.split(","):
+            token = token.strip()
+            if not token:
+                continue
+            if "=" not in token:
+                raise ValueError(
+                    f"chaos spec token {token!r} is not key=value "
+                    "(expected e.g. 'kill=0.01,flaky=0.05,seed=7')")
+            key, val = token.split("=", 1)
+            key = key.strip().lower()
+            if key not in ("kill", "wedge", "flaky", "slow", "seed"):
+                raise ValueError(
+                    f"unknown chaos spec key {key!r} (expected "
+                    "kill/wedge/flaky/slow/seed)")
+            try:
+                if key == "wedge":
+                    rate, _, stall = val.partition(":")
+                    kw["wedge"] = float(rate)
+                    if stall:
+                        kw["wedge_s"] = float(stall)
+                elif key == "slow":
+                    rate, _, mult = val.partition(":")
+                    kw["slow"] = float(rate)
+                    if mult:
+                        kw["slow_mult"] = float(mult)
+                elif key == "seed":
+                    kw["seed"] = int(val)
+                else:
+                    kw[key] = float(val)
+            except ValueError as e:
+                raise ValueError(
+                    f"chaos spec token {token!r}: {e}") from None
+        return cls(**kw)
+
+
+class ChaosPlan:
+    """Dense per-``(replica, dispatch)`` chaos schedule.
+
+    ``roles`` is a host-side ``(n_replicas, horizon)`` int8 matrix of
+    role codes (:data:`CLEAN`/:data:`KILL`/:data:`WEDGE`/
+    :data:`FLAKY`/:data:`SLOW`); ``wedge_s``/``slow_mult`` shape the
+    wedge stall and slow multiplier for every such cell. Construction
+    is deterministic in the spec: the same :class:`ChaosSpec` always
+    builds the identical plan, which is what makes the failover test
+    suite's "same seed ⇒ same kill schedule, same requeue counts"
+    pins possible. Dispatches past the horizon are clean.
+    """
+
+    def __init__(self, roles, wedge_s: float = 0.25,
+                 slow_mult: float = 3.0):
+        roles = np.asarray(roles, np.int8)
+        if roles.ndim != 2:
+            raise ValueError(
+                f"ChaosPlan roles must be (n_replicas, horizon), got "
+                f"shape {roles.shape}")
+        if roles.size and (roles.min() < CLEAN or roles.max() > SLOW):
+            raise ValueError(
+                f"ChaosPlan roles must be codes in [{CLEAN}, {SLOW}], "
+                f"got range [{roles.min()}, {roles.max()}]")
+        if not (np.isfinite(wedge_s) and wedge_s > 0):
+            raise ValueError(f"wedge_s={wedge_s} must be positive")
+        if not (np.isfinite(slow_mult) and slow_mult >= 1.0):
+            raise ValueError(f"slow_mult={slow_mult} must be >= 1")
+        self.roles = roles
+        self.wedge_s = float(wedge_s)
+        self.slow_mult = float(slow_mult)
+        self.n_replicas, self.horizon = roles.shape
+
+    @classmethod
+    def build(cls, spec: ChaosSpec, n_replicas: int,
+              horizon: int = 4096) -> "ChaosPlan":
+        """Expand a spec over the full horizon: one uniform draw per
+        cell assigns at most one role (kill wins over wedge over flaky
+        over slow), so rates compose without overlap — the
+        ``FaultPlan.build`` construction on the serving axis."""
+        if n_replicas < 1 or horizon < 1:
+            raise ValueError(
+                f"need n_replicas >= 1 and horizon >= 1, got "
+                f"({n_replicas}, {horizon})")
+        rs = np.random.RandomState(spec.seed)
+        u = rs.random_sample((n_replicas, horizon))
+        roles = np.zeros((n_replicas, horizon), np.int8)
+        k = u < spec.kill
+        w = ~k & (u < spec.kill + spec.wedge)
+        f = ~k & ~w & (u < spec.kill + spec.wedge + spec.flaky)
+        s = (~k & ~w & ~f
+             & (u < spec.kill + spec.wedge + spec.flaky + spec.slow))
+        roles[k], roles[w], roles[f], roles[s] = KILL, WEDGE, FLAKY, SLOW
+        return cls(roles, wedge_s=spec.wedge_s, slow_mult=spec.slow_mult)
+
+    @classmethod
+    def scripted(cls, n_replicas: int, kills: dict | None = None,
+                 wedges: dict | None = None, flaky: dict | None = None,
+                 slow: dict | None = None, horizon: int | None = None,
+                 wedge_s: float = 0.25,
+                 slow_mult: float = 3.0) -> "ChaosPlan":
+        """Exact-placement construction: ``kills`` maps replica ->
+        the dispatch index it dies on; ``wedges``/``flaky``/``slow``
+        map replica -> an iterable of dispatch indices. The bench's
+        chaos leg uses this to kill specific replicas mid-stream on
+        every run — no rate sampling, pure schedule."""
+        cells = []
+        for role, spec_map, single in ((KILL, kills, True),
+                                       (WEDGE, wedges, False),
+                                       (FLAKY, flaky, False),
+                                       (SLOW, slow, False)):
+            for rep, where in (spec_map or {}).items():
+                rep = int(rep)
+                if not 0 <= rep < n_replicas:
+                    raise ValueError(
+                        f"replica {rep} out of range for a "
+                        f"{n_replicas}-replica plan")
+                idxs = [where] if single else list(where)
+                for i in idxs:
+                    i = int(i)
+                    if i < 0:
+                        raise ValueError(
+                            f"dispatch index {i} must be >= 0")
+                    cells.append((rep, i, role))
+        top = max((i for _, i, _ in cells), default=-1)
+        horizon = (top + 1 if horizon is None else int(horizon))
+        horizon = max(1, horizon)
+        roles = np.zeros((n_replicas, horizon), np.int8)
+        for rep, i, role in cells:
+            if i >= horizon:
+                raise ValueError(
+                    f"dispatch index {i} outside the horizon {horizon}")
+            if roles[rep, i] != CLEAN:
+                raise ValueError(
+                    f"cell (replica {rep}, dispatch {i}) assigned two "
+                    f"roles ({_ROLE_NAMES[int(roles[rep, i])]} and "
+                    f"{_ROLE_NAMES[role]}) — chaos roles are mutually "
+                    "exclusive per cell")
+            roles[rep, i] = role
+        return cls(roles, wedge_s=wedge_s, slow_mult=slow_mult)
+
+    def role(self, replica: int, dispatch: int) -> int:
+        """The role code of one dispatch (CLEAN past the horizon)."""
+        if dispatch >= self.horizon:
+            return CLEAN
+        return int(self.roles[replica, dispatch])
+
+    def kill_at(self, replica: int) -> int | None:
+        """The dispatch index ``replica`` dies on, or None — plan
+        facts, available before anything runs (the determinism tests
+        pin the observed kill against this)."""
+        hits = np.flatnonzero(self.roles[replica] == KILL)
+        return int(hits[0]) if hits.size else None
+
+    def kills_planned(self) -> dict[int, int]:
+        """``{replica: first kill dispatch}`` over the whole plan."""
+        out = {}
+        for r in range(self.n_replicas):
+            k = self.kill_at(r)
+            if k is not None:
+                out[r] = k
+        return out
+
+
+def resolve_chaos_plan(chaos, n_replicas: int,
+                       horizon: int = 4096) -> ChaosPlan | None:
+    """Normalize the ``chaos=`` argument the replica set accepts: None
+    (clean — dispatches run bit-identically to a fleet built without
+    this module), a spec string, a :class:`ChaosSpec`, or a prebuilt
+    :class:`ChaosPlan` (shape-checked against this fleet)."""
+    if chaos is None:
+        return None
+    if isinstance(chaos, str):
+        chaos = ChaosSpec.parse(chaos)
+    if isinstance(chaos, ChaosSpec):
+        return ChaosPlan.build(chaos, n_replicas, horizon)
+    if isinstance(chaos, ChaosPlan):
+        if chaos.n_replicas != n_replicas:
+            raise ValueError(
+                f"ChaosPlan is for {chaos.n_replicas} replicas but "
+                f"this fleet has {n_replicas}; rebuild the plan")
+        return chaos
+    raise TypeError(
+        f"chaos must be None, a spec string, a ChaosSpec or a "
+        f"ChaosPlan, got {type(chaos).__name__}")

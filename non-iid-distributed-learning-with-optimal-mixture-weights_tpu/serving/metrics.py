@@ -256,6 +256,14 @@ class ServeMetrics:
                 # no swap ever recorded: the engine's own live version
                 # is the honest default (a single-version service)
                 snap["model_version"] = getattr(engine, "version", None)
+            stats = getattr(engine, "replica_stats", None)
+            if callable(stats):
+                # the failover plane (serving/replica.py): per-replica
+                # routed/ok/failed/requeued counters + circuit state,
+                # plus fleet totals (requeues/hedges/hedge_wins/dead).
+                # Pulled at snapshot time like compile_count — the
+                # router owns the counters; the snapshot reports them.
+                snap["failover"] = stats()
         if self.staleness_of is not None \
                 and snap["model_version"] is not None:
             try:

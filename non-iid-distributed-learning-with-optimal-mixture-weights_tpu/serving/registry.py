@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import re
 import threading
 import time
 from typing import Any, Iterator
@@ -191,3 +192,154 @@ class ModelRegistry:
                 del self._entries[v]
                 removed.append(v)
         return removed
+
+
+#: Checkpoint-directory names a watcher publishes: the ``vNNNN``
+#: entries ``exp.py --publish_every`` writes (any digit count — v0100
+#: and v100000 both match; the number orders ingestion).
+_VERSION_DIR = re.compile(r"^v(\d+)$")
+
+
+class CheckpointWatcher:
+    """Daemon thread that polls a checkpoint directory and publishes
+    new ``vNNNN`` entries into a :class:`ModelRegistry` — the
+    cross-process half of the train->serve loop. Training writes
+    checkpoints (``exp.py --save_models DIR --publish_every N``);
+    serving runs a watcher over ``DIR/{dataset}_{algo}_repeatT`` and
+    every boundary's model appears in the registry without any
+    explicit ``publish_checkpoint`` call (the PR 6 follow-on).
+
+    Semantics:
+
+    - entries are ingested in **round order** (the numeric ``vNNNN``
+      suffix), so staleness accounting stays monotone;
+    - a directory that fails to load (a checkpoint mid-write, a
+      truncated file) **stops the poll** — it is retried next poll
+      (only marked seen once ``publish_checkpoint`` succeeds) and
+      LATER rounds wait behind it, because publishing them first
+      would hand the recovered earlier round a higher registry
+      version and regress ``latest()`` by a round; the failure is
+      counted in ``errors`` (never raised into the daemon, which
+      must outlive transient filesystem states);
+    - the poll interval is **bounded below** (0.01 s): a zero/negative
+      interval would busy-spin a core against the filesystem;
+    - ``stop()`` is a **clean shutdown**: it wakes the sleeper, joins
+      the thread, and is idempotent; the watcher is also a context
+      manager (``with CheckpointWatcher(...) as w:``).
+
+    ``on_publish(version, path)`` runs after each successful publish
+    (e.g. to stage a rollout candidate); its exceptions are counted in
+    ``errors`` rather than killing the watcher.
+    """
+
+    def __init__(self, registry: ModelRegistry, watch_dir: str,
+                 poll_interval_s: float = 1.0, metadata: dict | None = None,
+                 on_publish=None):
+        if poll_interval_s < 0.01:
+            raise ValueError(
+                f"poll_interval_s={poll_interval_s} must be >= 0.01 "
+                "(an unbounded poll would busy-spin against the "
+                "filesystem)")
+        self.registry = registry
+        self.watch_dir = str(watch_dir)
+        self.poll_interval_s = float(poll_interval_s)
+        self.metadata = dict(metadata) if metadata else None
+        self.on_publish = on_publish
+        self._seen: set[str] = set()
+        self._lock = threading.Lock()
+        # serializes whole poll bodies (daemon vs synchronous
+        # poll_once callers): two concurrent scans would both see the
+        # same entry as unseen and double-publish it — the registry
+        # assigns a fresh version per publish, no dedup downstream
+        self._poll_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.published: list[tuple[str, int]] = []  # (dirname, version)
+        self.errors = 0
+        self.polls = 0
+
+    # -- one poll (also usable synchronously, e.g. in tests) ----------
+    def poll_once(self) -> list[int]:
+        """Scan the directory once; publish every unseen ``vNNNN``
+        entry in round order. Returns the versions published. Safe to
+        call while the daemon runs (polls are serialized)."""
+        with self._poll_lock:
+            return self._poll_once()
+
+    def _poll_once(self) -> list[int]:
+        with self._lock:
+            self.polls += 1
+        try:
+            names = os.listdir(self.watch_dir)
+        except OSError:
+            # the directory may not exist yet (training starts later);
+            # that is a normal startup state, not an error
+            return []
+        entries = []
+        for name in names:
+            m = _VERSION_DIR.match(name)
+            if m and name not in self._seen:
+                entries.append((int(m.group(1)), name))
+        out = []
+        for _, name in sorted(entries):
+            path = os.path.join(self.watch_dir, name)
+            if not os.path.isdir(path):
+                continue
+            try:
+                v = self.registry.publish_checkpoint(
+                    path, metadata=self.metadata)
+            except Exception:
+                # mid-write / damaged: retry next poll, never mark
+                # seen — and STOP here: publishing later rounds now
+                # would give this round a higher registry version when
+                # it recovers, regressing latest() by a round
+                with self._lock:
+                    self.errors += 1
+                break
+            self._seen.add(name)
+            with self._lock:
+                self.published.append((name, v))
+            out.append(v)
+            if self.on_publish is not None:
+                try:
+                    self.on_publish(v, path)
+                except Exception:
+                    with self._lock:
+                        self.errors += 1
+        return out
+
+    # -- lifecycle ----------------------------------------------------
+    def _run(self) -> None:
+        # poll immediately (existing checkpoints are servable NOW),
+        # then on the bounded interval until stopped; Event.wait is
+        # the sleeper AND the wakeup, so stop() never waits out a full
+        # interval
+        self.poll_once()
+        while not self._stop.wait(self.poll_interval_s):
+            self.poll_once()
+
+    def start(self) -> "CheckpointWatcher":
+        if self._thread is not None:
+            raise RuntimeError("watcher already started")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="ckpt-watcher", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 10.0) -> None:
+        """Clean shutdown: wake the sleeper, join, idempotent."""
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=timeout_s)
+        if self._thread.is_alive():  # pragma: no cover - join timeout
+            raise RuntimeError("checkpoint watcher did not stop in "
+                               f"{timeout_s}s")
+        self._thread = None
+
+    def __enter__(self) -> "CheckpointWatcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

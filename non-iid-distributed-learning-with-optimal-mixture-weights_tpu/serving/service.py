@@ -15,6 +15,15 @@ of queueing work that would only time out later — bounded queue depth is
 what keeps p99 bounded under a load spike. Per-request deadlines are
 enforced at dequeue: a request that waited past its deadline is resolved
 with :class:`DeadlineExceeded` and never spends engine time.
+
+The ``engine`` may be a :class:`~serving.replica.FailoverRouter` over a
+replica fleet: the service detects its ``deadline=`` capability once
+and passes each batch's earliest request deadline into dispatch, so a
+dead replica's in-flight batch requeues against survivors only while
+some caller can still make its deadline; the router's per-dispatch
+``replica_id``/``failovers``/``hedged`` dimensions ride the same
+``pop_timings`` slot as the stage split and land on every served
+request span.
 """
 
 from __future__ import annotations
@@ -148,14 +157,19 @@ class ServingService:
         # capability check once, not per probe: whether the engine's
         # predict supports the out-of-band record_timings=False mode
         # (a TypeError-based fallback at dispatch time would misread a
-        # genuine TypeError from inside predict as a missing kwarg)
+        # genuine TypeError from inside predict as a missing kwarg),
+        # and whether it takes the failover deadline (a FailoverRouter
+        # stops requeueing a dead replica's batch once the earliest
+        # request deadline passes; a plain engine has no use for it)
         try:
             import inspect
 
-            self._predict_untimed = "record_timings" in \
-                inspect.signature(engine.predict).parameters
+            sig_params = inspect.signature(engine.predict).parameters
+            self._predict_untimed = "record_timings" in sig_params
+            self._predict_deadline = "deadline" in sig_params
         except (TypeError, ValueError):
             self._predict_untimed = False
+            self._predict_deadline = False
         self._q: queue.Queue[_Request] = queue.Queue()
         # accepted-but-unserved request count, mutated under the lock:
         # a bare qsize()-then-put check is a race (N concurrent submits
@@ -183,7 +197,7 @@ class ServingService:
     def _trace_request(self, req: _Request, outcome: str, done: float,
                        queue_s=None, pad_s=None, device_s=None,
                        batch_id=None, where=None, version=None,
-                       staleness=None) -> None:
+                       staleness=None, extra=None) -> None:
         """Emit the one ``"request"`` span a submitted request gets at
         resolution — whichever path resolved it (served, deadline,
         error, shutdown), so the exported trace holds every accepted
@@ -194,7 +208,11 @@ class ServingService:
         dimensions: ``model_version`` (the version that answered, or
         the live version at resolution for unserved outcomes) and
         ``staleness_rounds`` (how far that version trails the newest
-        published model)."""
+        published model). ``extra``: the failover dimensions a
+        FailoverRouter reports per dispatch (``replica_id`` — which
+        replica answered; ``failovers`` — how many dead/failed
+        replicas this batch requeued past; ``hedged``), merged into
+        the span attrs so a requeued request is attributable."""
         if not self.tracer.enabled:
             return
         if version is None:
@@ -217,6 +235,8 @@ class ServingService:
             attrs["device_ms"] = device_s * 1e3
         if batch_id is not None:
             attrs["batch"] = batch_id
+        if extra:
+            attrs.update(extra)
         if outcome == "deadline":
             self.tracer.annotate("deadline_exceeded", req.id,
                                  where=where or "queued")
@@ -224,19 +244,28 @@ class ServingService:
                          done - req.t_submit, attrs=attrs)
 
     def _engine_stage_split(self, fallback_device_s: float) -> tuple:
-        """``(pad_s, device_s, version)`` of the engine call that just
-        returned: the engine's own host-timed split when it exposes
-        one (``ServingEngine.pop_timings``) — which also names the
-        model version that actually answered — else the whole call
+        """``(pad_s, device_s, version, extra)`` of the engine call
+        that just returned: the engine's own host-timed split when it
+        exposes one (``ServingEngine.pop_timings``) — which also names
+        the model version that actually answered — else the whole call
         billed to the device stage with the engine's live version
-        (honest for a custom engine with no split)."""
+        (honest for a custom engine with no split). ``extra`` is the
+        failover dimensions a FailoverRouter stamps into its timing
+        slot (replica_id / failovers / hedged); empty for a bare
+        engine."""
         pop = getattr(self.engine, "pop_timings", None)
         timing = pop() if pop is not None else None
         if timing:
+            extra = {}
+            if "replica" in timing:
+                extra["replica_id"] = timing["replica"]
+                extra["failovers"] = timing.get("failovers", 0)
+                if timing.get("hedged"):
+                    extra["hedged"] = True
             return (timing["pad_s"], timing["dispatch_s"],
-                    timing.get("version"))
-        return 0.0, fallback_device_s, getattr(self.engine, "version",
-                                               None)
+                    timing.get("version"), extra)
+        return (0.0, fallback_device_s,
+                getattr(self.engine, "version", None), {})
 
     # -- lifecycle ----------------------------------------------------
     def start(self) -> "ServingService":
@@ -321,7 +350,8 @@ class ServingService:
                 continue
             done = time.perf_counter()
             queue_s = t_seen - req.t_submit
-            pad_s, device_s, ver = self._engine_stage_split(done - t_seen)
+            pad_s, device_s, ver, rext = self._engine_stage_split(
+                done - t_seen)
             # same accounting as the worker path: served is served,
             # whichever thread resolved it — and metrics before the
             # future, so a caller's post-result snapshot counts it
@@ -333,7 +363,7 @@ class ServingService:
                 request_retries=[req.retries], version=ver)
             self._trace_request(req, "ok", done, queue_s=queue_s,
                                 pad_s=pad_s, device_s=device_s,
-                                version=ver)
+                                version=ver, extra=rext)
             _resolve(req.future, result=out)
 
     def __enter__(self):
@@ -561,8 +591,21 @@ class ServingService:
         while True:
             try:
                 t_d0 = time.perf_counter()
-                raw = (self.engine.predict(X) if use_version is None
-                       else self.engine.predict(X, version=use_version))
+                kw = {}
+                if use_version is not None:
+                    kw["version"] = use_version
+                if self._predict_deadline:
+                    # the batch's earliest live deadline bounds the
+                    # router's failover walk: a dead replica's batch
+                    # requeues against survivors only while some
+                    # caller can still be answered in time (recomputed
+                    # per attempt — the deadline trim below shrinks
+                    # `live`)
+                    dls = [r.deadline for r in live
+                           if r.deadline is not None]
+                    if dls:
+                        kw["deadline"] = min(dls)
+                raw = self.engine.predict(X, **kw)
                 predict_s = time.perf_counter() - t_d0
                 outs = split_results(raw, spans)
                 break
@@ -643,7 +686,8 @@ class ServingService:
                     # of a subset cannot raise
                     X, spans = coalesce([r.x for r in live])
         done = time.perf_counter()
-        pad_s, device_s, served_ver = self._engine_stage_split(predict_s)
+        pad_s, device_s, served_ver, rext = self._engine_stage_split(
+            predict_s)
         pad_s += coalesce_s  # host-side stacking is part of the stage
         queue_waits = [t_formed - r.t_submit for r in live]
         if use_version is not None and router is not None:
@@ -667,7 +711,7 @@ class ServingService:
             self._trace_request(req, "ok", done, queue_s=q_s,
                                 pad_s=pad_s, device_s=device_s,
                                 batch_id=bid, version=served_ver,
-                                staleness=stale)
+                                staleness=stale, extra=rext)
         for req, out in zip(live, outs):
             _resolve(req.future, result=out)
         return list(zip(live, outs))

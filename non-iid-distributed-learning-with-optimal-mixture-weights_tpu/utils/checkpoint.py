@@ -47,7 +47,8 @@ def _to_host(tree):
 
 def save_checkpoint(path: str, params, p=None, round_idx: int | None = None,
                     extra: dict | None = None, rff=None,
-                    feature_dtype=None, reputation=None) -> str:
+                    feature_dtype=None, reputation=None,
+                    defense_state: dict | None = None) -> str:
     """Save algorithm state under ``path`` (a directory). Returns the
     path actually written.
 
@@ -62,7 +63,12 @@ def save_checkpoint(path: str, params, p=None, round_idx: int | None = None,
     a rep-defended run (``res['reputation']`` under
     ``return_state=True``): resuming through a checkpoint without it
     restarts every client — including a quarantined attacker — at full
-    trust.
+    trust. ``defense_state`` carries the remaining cross-round defense
+    carry as a small dict of scalars/arrays — today the
+    ``quarantine:auto`` threshold estimate (``{'zq': res['zq']}``);
+    without it a resumed auto-threshold run re-tunes from the Z=5
+    start. (``reputation`` predates this dict and stays a top-level
+    key for checkpoint compatibility.)
     """
     state: dict[str, Any] = {"params": _to_host(params)}
     if p is not None:
@@ -71,6 +77,10 @@ def save_checkpoint(path: str, params, p=None, round_idx: int | None = None,
         state["round"] = int(round_idx)
     if reputation is not None:
         state["reputation"] = np.asarray(reputation, np.float32)
+    if defense_state:
+        state["defense_state"] = {
+            k: np.asarray(v, np.float32)
+            for k, v in defense_state.items()}
     if rff is not None:
         state["rff_W"] = np.asarray(rff[0])
         state["rff_b"] = np.asarray(rff[1])

@@ -272,6 +272,28 @@ def format_rollout_report(rollout: dict) -> str:
     return " ".join(str(b) for b in bits)
 
 
+def format_failover_report(chaos: dict) -> str:
+    """One human-readable line for a chaos-injected failover leg (the
+    ``chaos`` section ``serve_bench.py`` emits — replica deaths,
+    requeues, hedge wins, the tail with and without chaos, and the
+    zero-lost / zero-recompile pins): the failover-plane mirror of
+    :func:`format_rollout_report`."""
+    bits = [f"chaos [{chaos.get('replicas', '?')} replicas]:",
+            f"{chaos.get('kills_observed', 0)}/"
+            f"{chaos.get('kills_planned', 0)} kills",
+            f"{chaos.get('requeues', 0)} requeues",
+            f"{chaos.get('hedge_wins', 0)}/{chaos.get('hedges', 0)} "
+            "hedge wins"]
+    bits.append(f"{chaos.get('resolved_ok', 0)} ok + "
+                f"{chaos.get('deadline_exceeded', 0)} deadline of "
+                f"{chaos.get('requests', 0)} "
+                f"({chaos.get('lost', '?')} lost)")
+    bits.append(f"p95 {chaos.get('p95_ms_chaos')}ms vs "
+                f"{chaos.get('p95_ms_clean')}ms clean")
+    bits.append(f"recompiles {chaos.get('recompiles_during_chaos')}")
+    return " ".join(str(b) for b in bits)
+
+
 def load_results(path: str) -> dict:
     """Load an ``exp1_{dataset}.pkl`` result dict (driver schema)."""
     with open(path, "rb") as f:

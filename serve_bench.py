@@ -48,17 +48,32 @@ deliberate parity-gate failure that must ROLL BACK (sign-flipped
 weights published under the clean model's eval accuracy). The artifact
 grows a ``rollout`` section (swap latency percentiles, in-flight
 latency across swaps, canary/drill verdicts, final version +
-staleness) and the schema bumps to BENCH_SERVE.v2; with SERVE_TRACE
-set the loop's spans stream through the rotating JSONL writer
-(``utils.trace.RotatingJsonlWriter``) instead of the in-memory
-collector — the long-lived-loop mode.
+staleness); with SERVE_TRACE set the loop's spans stream through the
+rotating JSONL writer (``utils.trace.RotatingJsonlWriter``) instead of
+the in-memory collector — the long-lived-loop mode.
+
+The ISSUE 7 failover leg (``chaos``) proves the replica fleet under
+deterministic chaos: the same engine behind 3 replicas (ONE shared
+compiled ladder) and a health-gating ``FailoverRouter``, streamed
+clean for a baseline tail, then under a scripted ``ChaosPlan`` that
+wedges one replica (hedged past) and KILLS two mid-stream. Abort-grade
+pins, like parity: every accepted request resolves (success or
+explicit DeadlineExceeded — none lost or hung), every request id lands
+exactly one span, at least one kill actually fires, and
+``compile_count`` stays flat across kills/failovers. The artifact
+grows a ``chaos`` section (kills/requeues/hedge-wins counters,
+per-replica health, p95 with vs without chaos) and the schema bumps to
+BENCH_SERVE.v3.
 
 Env knobs: SERVE_BUCKETS ("1,8,64,512"), SERVE_D (RFF width, 256),
 SERVE_N (train rows, 4096), SERVE_CLIENTS (8), SERVE_TRAIN_ROUNDS (2),
 SERVE_ITERS (per-bucket timed calls, 30), SERVE_REQUESTS (mixed-stream
 requests, 200), SERVE_MAX_WAIT_MS (2.0), SERVE_SWAPS (hot swaps in the
 rollout leg, default 3, floor 2 — the series is N-1 bare timed swaps
-plus one shadow canary), SERVE_CKPT (serve an existing checkpoint dir instead
+plus one shadow canary), SERVE_CHAOS_REQUESTS (chaos-leg stream
+length, default max(SERVE_REQUESTS, 120) — long enough that the
+scripted per-replica kill indices land mid-stream), SERVE_CKPT (serve
+an existing checkpoint dir instead
 of training), SERVE_OUT, SERVE_ROUND (artifact suffix, default 1),
 SERVE_TRACE (directory: export the traced leg's span records as JSONL
 there, and stream the rollout leg's spans there as rotating parts),
@@ -372,6 +387,128 @@ def loop_bench(engine, parity_xy, eval_acc, n_swaps, max_wait_ms, rng,
     return section
 
 
+def chaos_bench(engine, n_requests, max_wait_ms):
+    """The ISSUE 7 failover leg: the mixed stream re-run over a
+    3-replica fleet (one shared compiled ladder) behind the
+    FailoverRouter, first clean, then under a SCRIPTED chaos plan that
+    wedges one replica (hedged past) and kills two mid-stream. The
+    acceptance pins are abort-grade, like parity: every accepted
+    request must resolve (success or explicit DeadlineExceeded — none
+    lost or hung), every request id must land exactly one span, at
+    least one scripted kill must actually fire (a chaos leg that never
+    exercised failover proves nothing), and the compile count must
+    stay flat across kills and failovers. Returns the artifact
+    ``chaos`` section (BENCH_SERVE.v3)."""
+    from fedamw_tpu.serving import (ChaosPlan, DeadlineExceeded,
+                                    FailoverRouter, ReplicaSet,
+                                    ServingService)
+    from fedamw_tpu.utils.trace import Tracer
+
+    n_replicas = 3
+    sizes = [1, 8, max(1, engine.buckets[-1] // 2)]
+    rng = np.random.RandomState(13)
+    payloads = [rng.randn(s, engine.input_dim).astype(np.float32)
+                for s in sizes]
+    cc0 = engine.compile_count
+
+    def stream(router, tracer=None):
+        """Paced request stream (many small batches, so the scripted
+        per-replica dispatch indices land mid-stream, not in one
+        giant coalesce); every future is awaited with a hard timeout
+        — a hung request surfaces as 'lost', never as a green run."""
+        ok = deadline = lost = 0
+        submitted = []
+        with ServingService(router, max_wait_ms=max_wait_ms,
+                            max_queue=max(1024, n_requests),
+                            tracer=tracer) as svc:
+            futs = []
+            for i in range(n_requests):
+                f = svc.submit(payloads[i % len(payloads)],
+                               timeout_s=30.0)
+                submitted.append(f.request_id)
+                futs.append(f)
+                time.sleep(0.0015)
+            for f in futs:
+                try:
+                    f.result(timeout=60)
+                    ok += 1
+                except DeadlineExceeded:
+                    deadline += 1
+                except Exception as e:
+                    print(f"# chaos stream: request failed "
+                          f"{type(e).__name__}: {e}", file=sys.stderr)
+                    lost += 1
+            snap = svc.metrics.snapshot(router)
+        return snap, ok, deadline, lost, submitted
+
+    # clean baseline: same fleet shape, no chaos — the p95 the chaos
+    # tail is judged against
+    with FailoverRouter(ReplicaSet(engine, n_replicas),
+                        policy="round_robin") as clean_router:
+        clean_snap, clean_ok, _, clean_lost, _ = stream(clean_router)
+
+    # scripted chaos, deterministic every run: replica 1 dies on its
+    # 3rd dispatch, replica 0 wedges on its 4th (the hedge masks the
+    # stall), replica 2 dies on its 6th — two of three replicas killed
+    # mid-stream, one survivor carrying the tail. Indices are LOW on
+    # purpose: the paced stream forms tens of micro-batches even on a
+    # loaded box, and a kill index the stream never reaches would
+    # abort the leg (kills_observed < 1 below)
+    plan = ChaosPlan.scripted(n_replicas, kills={1: 2, 2: 5},
+                              wedges={0: [3]}, wedge_s=0.25,
+                              horizon=65536)
+    tracer = Tracer(max_spans=4 * n_requests + 64)
+    # hedge_floor_ms sits far above any clean dispatch (sub-10ms even
+    # on a loaded box) and far below the 250ms wedge stall: ONLY the
+    # scripted wedge can cross the hedge threshold, so the leg's
+    # hedge/requeue counters — and the kill-cell dispatch indices,
+    # which a spurious mirror would otherwise consume — stay
+    # deterministic run to run
+    with FailoverRouter(ReplicaSet(engine, n_replicas, chaos=plan),
+                        policy="round_robin", hedge=True,
+                        hedge_min_samples=6,
+                        hedge_floor_ms=50.0) as router:
+        snap, ok, deadline, lost, submitted = stream(router, tracer)
+        fo = snap["failover"]
+
+    req_spans = [r for r in tracer.records() if r["name"] == "request"]
+    ids = [r["trace_id"] for r in req_spans]
+    spans_once = (sorted(ids) == sorted(submitted)
+                  and tracer.dropped == 0)
+    recompiles = engine.compile_count - cc0
+    section = {
+        "replicas": n_replicas,
+        "requests": n_requests,
+        "resolved_ok": ok,
+        "deadline_exceeded": deadline,
+        "lost": lost + clean_lost,
+        "kills_planned": len(plan.kills_planned()),
+        "kills_observed": fo["dead_replicas"],
+        "requeues": fo["requeues"],
+        "hedges": fo["hedges"],
+        "hedge_wins": fo["hedge_wins"],
+        "failed_over_requests": sum(
+            1 for r in req_spans if r["attrs"].get("failovers", 0)),
+        "p95_ms_clean": clean_snap["p95_ms"],
+        "p95_ms_chaos": snap["p95_ms"],
+        "p50_ms_clean": clean_snap["p50_ms"],
+        "p50_ms_chaos": snap["p50_ms"],
+        "recompiles_during_chaos": recompiles,
+        "spans_exactly_once": spans_once,
+        "per_replica": fo["replicas"],
+    }
+    if (section["lost"] or recompiles or not spans_once
+            or fo["dead_replicas"] < 1
+            or clean_ok != n_requests):
+        # abort-grade, like parity: a lost/hung request, a recompile
+        # under failover, a lost span, or a chaos schedule that never
+        # fired must not emit green-looking numbers
+        print(f"# serve_bench aborted: chaos leg failed "
+              f"({json.dumps(section)})", file=sys.stderr)
+        raise SystemExit(1)
+    return section
+
+
 def main():
     # shared prologue with bench.py (bench_common): re-apply
     # JAX_PLATFORMS over the container's sitecustomize, then the
@@ -503,9 +640,22 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
         rng=np.random.RandomState(7),
         trace_dir=os.environ.get("SERVE_TRACE") or None)
     loop_s = time.perf_counter() - t_loop0
-    from fedamw_tpu.utils.reporting import format_rollout_report
+    from fedamw_tpu.utils.reporting import (format_failover_report,
+                                            format_rollout_report)
 
     print(f"# {format_rollout_report(rollout)}", file=sys.stderr)
+
+    # ISSUE 7: the replica-fleet failover leg — the same engine behind
+    # N replicas and a health-gating router, first clean, then with
+    # replicas scripted to wedge/die mid-stream; zero lost requests and
+    # zero recompiles are abort-grade pins
+    t_chaos0 = time.perf_counter()
+    chaos = chaos_bench(
+        engine, n_requests=_env_int("SERVE_CHAOS_REQUESTS",
+                                    max(n_requests, 120)),
+        max_wait_ms=max_wait_ms)
+    chaos_s = time.perf_counter() - t_chaos0
+    print(f"# {format_failover_report(chaos)}", file=sys.stderr)
 
     # the zero-recompile pin now spans EVERY stream — untraced, traced,
     # and the rollout leg's swapped versions: tracing must not perturb
@@ -547,10 +697,11 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
 
     artifact = {
         "metric": "serve_bench",
-        # v2: the rollout section (continuous-deployment leg) is part
-        # of the contract — tools/check_bench_schema.py requires it
-        # from v2 on (v1 artifacts are grandfathered by version)
-        "schema": "BENCH_SERVE.v2",
+        # v3: the chaos section (replica-fleet failover leg) joins the
+        # v2 rollout section in the contract — tools/
+        # check_bench_schema.py requires each from its version on
+        # (earlier artifacts are grandfathered by schema version)
+        "schema": "BENCH_SERVE.v3",
         "platform": platform,
         "engine": {
             "buckets": list(engine.buckets),
@@ -564,10 +715,12 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
         "phases": {"build_s": round(build_s, 3),
                    "compile_warmup_s": round(warmup_s, 3),
                    "timed_run_s": round(timed_s, 3),
-                   "rollout_s": round(loop_s, 3)},
+                   "rollout_s": round(loop_s, 3),
+                   "chaos_s": round(chaos_s, 3)},
         "bucket_latency": bucket_latency,
         "mixed_stream": stream,
         "rollout": rollout,
+        "chaos": chaos,
         "trace": {
             "request_spans": len(req_spans),
             "unique_request_ids": len(set(ids)),
@@ -592,6 +745,22 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
     with open(out_path, "w") as f:
         json.dump(artifact, f, indent=1)
     print(f"# artifact -> {out_path}", file=sys.stderr)
+
+    # the chaos-leg line (before the headline, which stays LAST): the
+    # failover evidence — kills fired, requeues landed, nothing lost,
+    # and what chaos cost the tail
+    print(json.dumps({
+        "metric": "serve_chaos",
+        "value": chaos["p95_ms_chaos"],
+        "unit": "ms-p95-under-chaos",
+        "p95_ms_clean": chaos["p95_ms_clean"],
+        "kills": chaos["kills_observed"],
+        "requeues": chaos["requeues"],
+        "hedge_wins": chaos["hedge_wins"],
+        "lost": chaos["lost"],
+        "recompiles_during_chaos": chaos["recompiles_during_chaos"],
+        "platform": platform,
+    }))
 
     # the rollout-leg line (before the headline, which stays LAST):
     # swap latency is the number an operator sizes a publish cadence by

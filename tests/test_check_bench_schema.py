@@ -135,6 +135,59 @@ def test_serve_v2_rejects_rollout_drift(tmp_path):
     assert any("FAILED" in e for e in cbs.validate_file(p))
 
 
+GOOD_CHAOS = {"replicas": 3, "requests": 120, "resolved_ok": 118,
+              "deadline_exceeded": 2, "lost": 0, "kills_planned": 2,
+              "kills_observed": 2, "requeues": 2, "hedges": 1,
+              "hedge_wins": 1, "p95_ms_clean": 3.1, "p95_ms_chaos": 3.6,
+              "recompiles_during_chaos": 0, "spans_exactly_once": True}
+
+
+def _serve_art(schema="BENCH_SERVE.v3", **extra):
+    art = {"metric": "serve_bench", "schema": schema,
+           "platform": "cpu",
+           "bucket_latency": {"1": {"p50_ms": 0.1, "p99_ms": 0.2}},
+           "mixed_stream": {"requests": 10},
+           "recompiles_after_warmup": 0,
+           "rollout": dict(GOOD_ROLLOUT)}
+    art.update(extra)
+    return art
+
+
+def test_serve_v3_requires_chaos_section(tmp_path):
+    """From schema v3 on, the replica-fleet failover leg's 'chaos'
+    section is contract; v2 artifacts predate it and stay valid."""
+    p = _write(tmp_path, "BENCH_SERVE_r09.json", _serve_art())
+    errs = cbs.validate_file(p)
+    assert any("'chaos' section" in e for e in errs)
+    good = _serve_art(chaos=dict(GOOD_CHAOS))
+    assert cbs.validate_file(
+        _write(tmp_path, "BENCH_SERVE_r09.json", good)) == []
+    # v2 stays valid without the section (pre-ISSUE-7 shape)
+    v2 = _serve_art(schema="BENCH_SERVE.v2")
+    assert cbs.validate_file(
+        _write(tmp_path, "BENCH_SERVE_r09.json", v2)) == []
+
+
+def test_serve_v3_rejects_chaos_drift(tmp_path):
+    for key, bad in (("kills_observed", None), ("requeues", -1),
+                     ("hedge_wins", None), ("requests", 0),
+                     ("p95_ms_clean", None), ("p95_ms_chaos", "slow"),
+                     ("spans_exactly_once", False)):
+        chaos = dict(GOOD_CHAOS, **{key: bad})
+        p = _write(tmp_path, "BENCH_SERVE_r09.json",
+                   _serve_art(chaos=chaos))
+        assert cbs.validate_file(p), f"accepted broken chaos {key}"
+    # the abort-grade pins, re-checked at the gate: lost requests and
+    # failover recompiles must never land in a committed artifact
+    for key, bad, needle in (("lost", 3, "lost"),
+                             ("recompiles_during_chaos", 1,
+                              "never recompile"),
+                             ("kills_observed", 0, "proves nothing")):
+        p = _write(tmp_path, "BENCH_SERVE_r09.json",
+                   _serve_art(chaos=dict(GOOD_CHAOS, **{key: bad})))
+        assert any(needle in e for e in cbs.validate_file(p))
+
+
 def test_rejects_multichip_ok_rc_disagreement(tmp_path):
     p = _write(tmp_path, "MULTICHIP_r09.json",
                {"n_devices": 8, "rc": 124, "ok": True, "tail": "OK"})

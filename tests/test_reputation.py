@@ -422,6 +422,74 @@ def test_reputation_roundtrips_through_checkpoint(tmp_path, setup_het):
                                   np.asarray(full["reputation"]))
 
 
+def test_auto_threshold_zq_roundtrips_through_checkpoint(tmp_path,
+                                                         setup_het):
+    """ISSUE 7 satellite: the `quarantine:auto` threshold estimate
+    (the carried clean-z quantile `zq`) persists through checkpoints
+    alongside reputation — prefix + save_checkpoint(defense_state=
+    {'zq': ...}) + resume reproduces the uninterrupted run's threshold
+    trajectory bitwise. Before this, a resumed auto-threshold run
+    re-tuned from the Z=5 start (the ROADMAP carried follow-on)."""
+    from fedamw_tpu.utils.checkpoint import (load_checkpoint,
+                                             save_checkpoint)
+
+    R = 6
+    kw = dict(robust_agg="quarantine:auto", return_state=True, **KW)
+    full = FedAvg(setup_het, round=R, **kw)
+    prefix = FedAvg(setup_het, round=R, stop_round=3, **kw)
+    # the estimate has moved off its Z_AUTO_INIT start by the boundary
+    # (otherwise this test could pass without any carry at all)
+    from fedamw_tpu.fedcore.robust import Z_AUTO_INIT
+    assert np.float32(prefix["zq"]) != np.float32(Z_AUTO_INIT)
+    save_checkpoint(str(tmp_path / "ck"), prefix["params"], round_idx=3,
+                    defense_state={"zq": prefix["zq"]})
+    state = load_checkpoint(str(tmp_path / "ck"))
+    # the stored estimate round-trips bitwise through either layout
+    np.testing.assert_array_equal(
+        np.asarray(state["defense_state"]["zq"], np.float32),
+        np.asarray(prefix["zq"], np.float32))
+    resumed = FedAvg(setup_het, round=R, start_round=3,
+                     resume_from=state, **kw)
+    # the stitched threshold trajectory IS the uninterrupted one
+    np.testing.assert_array_equal(
+        np.asarray(resumed["defense"]["z_threshold"]),
+        np.asarray(full["defense"]["z_threshold"])[3:])
+    np.testing.assert_array_equal(np.asarray(resumed["zq"]),
+                                  np.asarray(full["zq"]))
+    np.testing.assert_array_equal(np.asarray(resumed["test_acc"]),
+                                  np.asarray(full["test_acc"])[3:])
+
+
+def test_resume_auto_without_zq_warns_and_retunes_from_start(
+        setup_het):
+    """The legacy-checkpoint path: resuming a quarantine:auto run from
+    a state without 'zq' re-tunes from the Z=5 operating point — loud
+    (a warning naming the fix), not silent."""
+    R = 6
+    kw = dict(robust_agg="quarantine:auto", return_state=True, **KW)
+    prefix = FedAvg(setup_het, round=R, stop_round=3, **kw)
+    with pytest.warns(UserWarning, match="zq"):
+        resumed = FedAvg(setup_het, round=R, start_round=3,
+                         resume_from={"params": prefix["params"]}, **kw)
+    # restarted estimate: the first resumed threshold is back at the
+    # hand-tuned start, ABOVE the prefix's already-tightened carry
+    thr0 = float(np.asarray(resumed["defense"]["z_threshold"])[0])
+    assert thr0 == pytest.approx(5.0)
+    assert thr0 > float(np.asarray(
+        prefix["defense"]["z_threshold"])[-1])
+
+
+def test_resume_rejects_non_scalar_zq(setup_het):
+    prefix = FedAvg(setup_het, round=4, stop_round=2,
+                    robust_agg="quarantine:auto", return_state=True,
+                    **KW)
+    with pytest.raises(ValueError, match="scalar"):
+        FedAvg(setup_het, round=4, start_round=2,
+               resume_from={"params": prefix["params"],
+                            "zq": np.ones(3, np.float32)},
+               robust_agg="quarantine:auto", **KW)
+
+
 def test_resume_without_reputation_warns_and_restarts_trust(setup_het):
     """The legacy-checkpoint path: resuming a rep-defended run from a
     state without 'reputation' restarts everyone at full trust — loud

@@ -14,7 +14,11 @@ headline; the ISSUE 6 rollout leg — >= 3 hot swaps with zero
 recompiles, a promoted shadow canary, a parity-failure rollback
 drill, model_version/staleness_rounds dimensions in the snapshot and
 in every request span, and the rollout leg's spans STREAMED through
-rotating JSONL parts; and the strict-backend guard — BENCH_STRICT_TPU
+rotating JSONL parts; the ISSUE 7 chaos leg — scripted replica kills
+mid-stream on a 3-replica fleet with zero lost requests, dead-replica
+requeues, zero recompiles across failovers, and the p95-with/without-
+chaos comparison in a v3 ``chaos`` section; and the strict-backend
+guard — BENCH_STRICT_TPU
 must abort rc=1 on a leaked CPU backend BEFORE measuring anything,
 exactly like bench.py, so a CPU capture can never be harvested as TPU
 evidence.
@@ -76,6 +80,20 @@ def test_serve_bench_emits_driver_contract_json(tmp_path):
     # stability) landed exactly one span
     assert trace_lines[0]["request_spans"] == 200
 
+    # ISSUE 7 pins — the chaos line prints before the rollout line
+    # (headline still LAST): kills fired mid-stream, the dead
+    # replicas' in-flight batches requeued, nothing was lost, and the
+    # shared-ladder zero-recompile pin covers the failovers
+    chaos_lines = [l for l in lines if l["metric"] == "serve_chaos"]
+    assert len(chaos_lines) == 1 and chaos_lines[0] == lines[-4]
+    cl = chaos_lines[0]
+    assert cl["kills"] >= 1
+    assert cl["requeues"] >= 1
+    assert cl["lost"] == 0
+    assert cl["recompiles_during_chaos"] == 0
+    assert cl["value"] > 0  # p95 under chaos
+    assert cl["p95_ms_clean"] > 0
+
     # ISSUE 6 pins — the rollout line prints before the trace-overhead
     # line (headline still LAST): swaps took, the shadow canary
     # promoted, the parity drill rolled back, and the zero-recompile
@@ -92,7 +110,7 @@ def test_serve_bench_emits_driver_contract_json(tmp_path):
     # the artifact mirrors the lines and carries the parity verdict
     with open(out_path) as f:
         art = json.load(f)
-    assert art["schema"] == "BENCH_SERVE.v2"
+    assert art["schema"] == "BENCH_SERVE.v3"
     assert art["recompiles_after_warmup"] == 0
     assert len(art["bucket_latency"]) >= 3
     assert art["parity"]["match"] is True
@@ -143,6 +161,27 @@ def test_serve_bench_emits_driver_contract_json(tmp_path):
     # serving the newest SERVABLE model: zero staleness
     assert rollout["staleness_rounds"] == 0
     assert art["phases"]["rollout_s"] >= 0
+
+    # the chaos section: the failover evidence the v3 schema requires
+    # (tools/check_bench_schema.py gates it) — the acceptance pins of
+    # ISSUE 7, emitted not just enforced
+    chaos = art["chaos"]
+    assert chaos["replicas"] == 3
+    assert chaos["kills_observed"] == chaos["kills_planned"] == 2
+    assert chaos["requeues"] >= 2  # each kill's in-flight batch moved
+    assert chaos["lost"] == 0
+    assert chaos["resolved_ok"] + chaos["deadline_exceeded"] == \
+        chaos["requests"]
+    assert chaos["recompiles_during_chaos"] == 0
+    assert chaos["spans_exactly_once"] is True
+    assert chaos["p95_ms_clean"] > 0 and chaos["p95_ms_chaos"] > 0
+    # two replicas died; the survivor(s) carried the stream
+    dead = [r for r in chaos["per_replica"].values()
+            if r["state"] == "dead"]
+    assert len(dead) == 2
+    assert all(r["requeued"] == 1 for r in dead)
+    assert art["phases"]["chaos_s"] >= 0
+
     # the mixed stream predates any swap: served by the seed version,
     # zero staleness, and the new dimensions are present
     assert stream["model_version"] == 0

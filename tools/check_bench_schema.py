@@ -28,7 +28,12 @@ Three artifact families, three rule sets:
   canary/rollback-drill verdicts, and zero recompiles during swaps —
   v1 artifacts (r01) predate the leg and are grandfathered by schema
   version, so the rule stays strict for every artifact that could
-  carry it.
+  carry it. From schema v3 on, the ``chaos`` section (the ISSUE 7
+  replica-fleet failover leg) is required too: replica/kill/requeue/
+  hedge-win counts, p95 with AND without chaos, zero lost requests,
+  and zero recompiles during chaos — the abort-grade pins the bench
+  enforces, re-checked here so a hand-edited artifact can never land
+  green.
 - ``MULTICHIP_rNN.json`` — the dryrun wrapper: ``n_devices``/``rc``/
   ``ok``/``tail``, with ``ok`` true iff ``rc == 0`` (a disagreeing
   pair is exactly the silent-green failure this tool exists to catch).
@@ -137,7 +142,17 @@ def check_serve_artifact(art: dict, name: str) -> list[str]:
         errs.append("missing 'recompiles_after_warmup' (the "
                     "zero-recompile pin reads it)")
     errs.extend(_check_rollout_section(art, schema))
+    errs.extend(_check_chaos_section(art, schema))
     return errs
+
+
+def _schema_version(schema: str) -> int | None:
+    """The N of ``BENCH_SERVE.vN``, or None when unparseable (the
+    caller reports that as its own error exactly once)."""
+    try:
+        return int(schema.rsplit(".v", 1)[1])
+    except (IndexError, ValueError):
+        return None
 
 
 def _check_rollout_section(art: dict, schema: str) -> list[str]:
@@ -148,9 +163,8 @@ def _check_rollout_section(art: dict, schema: str) -> list[str]:
     version, like the BENCH_ platform label by capture number)."""
     if not schema.startswith("BENCH_SERVE."):
         return []  # family error already reported by the caller
-    try:
-        version = int(schema.rsplit(".v", 1)[1])
-    except (IndexError, ValueError):
+    version = _schema_version(schema)
+    if version is None:
         # 'BENCH_SERVE.v2-rc1' etc. would otherwise skip the v2 rules
         # entirely — the silent-green landing this gate exists to stop
         return [f"unparseable schema version {schema!r} "
@@ -183,6 +197,55 @@ def _check_rollout_section(art: dict, schema: str) -> list[str]:
             or not isinstance(rollout.get("staleness_rounds"), int):
         errs.append("rollout: missing 'final_version'/"
                     "'staleness_rounds' dimensions")
+    return errs
+
+
+def _check_chaos_section(art: dict, schema: str) -> list[str]:
+    """The v3+ ``chaos`` contract (the replica-fleet failover leg):
+    the driver reads the kill/requeue/hedge counters and the tail with
+    vs without chaos, and the abort-grade pins (zero lost requests,
+    zero recompiles across kills/failovers, at least one kill actually
+    fired, every span accounted exactly once) are re-checked here — a
+    hand-edited or drifted artifact must not land green. Earlier
+    schema versions predate the leg and are grandfathered."""
+    if not schema.startswith("BENCH_SERVE."):
+        return []  # family error already reported by the caller
+    version = _schema_version(schema)
+    if version is None:
+        return []  # the rollout check already reported it
+    if version < 3:
+        return []
+    chaos = art.get("chaos")
+    if not isinstance(chaos, dict):
+        return ["schema v3+ requires a 'chaos' section (the "
+                "replica-fleet failover leg)"]
+    errs = []
+    for key in ("replicas", "requests", "kills_observed", "requeues",
+                "hedge_wins"):
+        if not isinstance(chaos.get(key), int) or chaos[key] < 0:
+            errs.append(f"chaos: {key!r} must be a non-negative int")
+    if isinstance(chaos.get("requests"), int) and chaos["requests"] < 1:
+        errs.append("chaos: 'requests' must be positive")
+    if isinstance(chaos.get("kills_observed"), int) \
+            and chaos["kills_observed"] < 1:
+        errs.append("chaos: 'kills_observed' must be >= 1 (a chaos "
+                    "leg that never exercised failover proves nothing)")
+    for key in ("p95_ms_clean", "p95_ms_chaos"):
+        if not isinstance(chaos.get(key), (int, float)):
+            errs.append(f"chaos: missing numeric {key!r} (the tail "
+                        "with vs without chaos)")
+    if chaos.get("lost") != 0:
+        errs.append(f"chaos: lost={chaos.get('lost')!r} — every "
+                    "accepted request must resolve; a committed "
+                    "artifact may never carry lost requests")
+    if chaos.get("recompiles_during_chaos") != 0:
+        errs.append("chaos: recompiles_during_chaos="
+                    f"{chaos.get('recompiles_during_chaos')!r} — the "
+                    "fleet shares ONE compiled ladder; failover must "
+                    "never recompile")
+    if chaos.get("spans_exactly_once") is not True:
+        errs.append("chaos: 'spans_exactly_once' must be true (every "
+                    "accepted request id lands one span)")
     return errs
 
 
