@@ -124,6 +124,32 @@ def parse_args():
                          "in place of the weighted average; defense "
                          "telemetry (incl. reputation trajectories) is "
                          "reported after each algorithm")
+    ap.add_argument("--cohort_shards", type=int, default=0, metavar="S",
+                    help="extension (jax): split the client axis into S "
+                         "contiguous shards and aggregate in two tiers "
+                         "(fedcore.hierarchy) — per-shard partial sums "
+                         "folded globally. The shard count is traced "
+                         "DATA: any S reuses one compiled program, "
+                         "aggregates match the flat path to float "
+                         "tolerance, quarantine/gating decisions are "
+                         "bit-identical. Composes with --shard when S "
+                         "is a multiple of the mesh size (contiguous "
+                         "shard boundaries then align with device "
+                         "placement). 0 = the exact flat graph")
+    ap.add_argument("--stream_cohort", action="store_true",
+                    help="extension (jax; requires --cohort_shards): "
+                         "stream client shards host->device double-"
+                         "buffered (data.stream.CohortShardStream) "
+                         "through one compiled shard-tier program per "
+                         "round, so cohort size is bounded by host "
+                         "RAM, not HBM — the million-client mode "
+                         "(scale_bench.py cohort leg). FedAvg/FedProx "
+                         "run streamed; FedAMW falls back to in-graph "
+                         "sharding (the learned p-solve needs global "
+                         "logits — ROADMAP follow-on). Supports the "
+                         "mean-family defenses (clip/quarantine:Z, "
+                         "evidence shard-local); rep/auto/order-"
+                         "statistic specs need the in-graph mode")
     ap.add_argument("--feature_dtype", type=str, default=None,
                     choices=["bfloat16", "float16", "float32"],
                     help="extension (jax): store the mapped feature "
@@ -233,6 +259,55 @@ def parse_args():
     if args.feature_dtype is not None and args.backend != "jax":
         ap.error("--feature_dtype is a jax-backend extension (the "
                  "torch twin keeps the reference's float32 features)")
+    if args.cohort_shards or args.stream_cohort:
+        if args.backend != "jax":
+            ap.error("--cohort_shards/--stream_cohort are jax-backend "
+                     "extensions (the torch twin is the flat parity "
+                     "oracle)")
+        if args.cohort_shards < 0:
+            ap.error(f"--cohort_shards must be >= 0, got "
+                     f"{args.cohort_shards}")
+    if args.stream_cohort:
+        # the streamed tier's narrower surface fails at the flag
+        # boundary, not mid-run after earlier algorithms finished
+        if not args.cohort_shards:
+            ap.error("--stream_cohort needs --cohort_shards S >= 1 "
+                     "(the host->device shard size is the streaming "
+                     "knob)")
+        if args.sequential:
+            ap.error("--stream_cohort is incompatible with "
+                     "--sequential (the contamination chain is serial "
+                     "by construction; shards stream independently)")
+        if args.participation < 1.0:
+            ap.error("--stream_cohort does not support "
+                     "--participation < 1 yet; model dropout through "
+                     "--faults drop= instead")
+        if args.server_opt != "none":
+            ap.error("--stream_cohort does not compose with "
+                     "--server_opt yet")
+        if args.publish_every:
+            ap.error("--stream_cohort does not support segmented "
+                     "--publish_every runs yet")
+        from fedamw_tpu.fedcore.hierarchy import MAX_COHORT_SHARDS
+
+        if args.cohort_shards > MAX_COHORT_SHARDS:
+            ap.error(f"--stream_cohort --cohort_shards "
+                     f"{args.cohort_shards}: FedAMW falls back to "
+                     f"in-graph sharding (its p-solve needs global "
+                     f"logits), which caps at MAX_COHORT_SHARDS="
+                     f"{MAX_COHORT_SHARDS}; use <= {MAX_COHORT_SHARDS} "
+                     "shards, or drive the streamed algorithms alone "
+                     "through scale_bench.py's cohort leg")
+        from fedamw_tpu.fedcore.robust import parse_robust_spec as _prs
+
+        _rs = _prs(args.robust_agg)
+        if (_rs.agg != "mean" or _rs.rep_decay is not None
+                or _rs.zscore_auto):
+            ap.error(f"--stream_cohort supports the mean-family "
+                     f"defenses (clip:R, quarantine:Z); "
+                     f"--robust_agg {args.robust_agg!r} needs global "
+                     "statistics — use in-graph --cohort_shards "
+                     "without --stream_cohort")
     if args.publish_every:
         if args.publish_every < 0:
             ap.error(f"--publish_every must be >= 0, got "
@@ -468,6 +543,10 @@ _RESUME_LEGACY_DEFAULTS = {"model": "linear", "data_dir": "datasets",
                            # partial predates --feature_dtype and is a
                            # float32-feature run
                            "feature_dtype": None,
+                           # cohort plane (PR 8): a keyless partial
+                           # predates --cohort_shards/--stream_cohort
+                           # and is a flat run
+                           "cohort_shards": 0, "stream_cohort": False,
                            # FedAMW used to reject participation<1, so
                            # a legacy partial's FedAMW rows are always
                            # full-participation runs; signing the value
@@ -501,6 +580,10 @@ def _resume_config(args) -> dict:
     cfg["faults"] = args.faults
     cfg["robust_agg"] = args.robust_agg
     cfg["feature_dtype"] = args.feature_dtype
+    # the cohort plane shifts trajectories (two-tier re-association /
+    # shard-local streamed evidence), so it signs the partial too
+    cfg["cohort_shards"] = args.cohort_shards
+    cfg["stream_cohort"] = args.stream_cohort
     # see _RESUME_LEGACY_DEFAULTS: jax FedAMW now honors participation
     cfg["amw_participation"] = (args.participation
                                 if args.backend == "jax" else 1.0)
@@ -748,6 +831,23 @@ def _run_repeats(args, params, backend, train_mat, error_mat, acc_mat, hete,
                    server_opt=args.server_opt, server_lr=args.server_lr)
         amw_ext = ({"participation": args.participation}
                    if args.backend == "jax" else {})
+        if args.cohort_shards:
+            # the cohort plane (argparse-guarded to jax): in-graph
+            # two-tier sharding for all three round-based algorithms;
+            # --stream_cohort streams FedAvg/FedProx while FedAMW
+            # keeps the in-graph mode (its p-solve needs global
+            # logits — the ROADMAP follow-on)
+            ext["cohort_shards"] = args.cohort_shards
+            amw_ext["cohort_shards"] = args.cohort_shards
+            if args.stream_cohort:
+                ext["stream_cohort"] = True
+                if t == 0:
+                    print(f"cohort plane: FedAvg/FedProx stream "
+                          f"{args.cohort_shards} client shards "
+                          "host->device; FedAMW runs in-graph sharded")
+            elif t == 0:
+                print(f"cohort plane: in-graph two-tier aggregation "
+                      f"over {args.cohort_shards} client shards")
         fault_ext = {}
         if args.faults is not None or args.robust_agg != "mean":
             # argparse-guarded to the jax backend; the plan seed is

@@ -38,6 +38,7 @@ from ..fedcore import (
     client_logits,
     fednova_effective_weights,
     make_bucketed_round,
+    make_client_round,
     make_evaluator,
     make_local_update,
     make_p_solver,
@@ -45,15 +46,21 @@ from ..fedcore import (
     weighted_average,
 )
 from ..fedcore.faults import inject_fault_row, resolve_fault_plan
+from ..fedcore.hierarchy import (
+    fold_summaries,
+    make_shard_tier,
+    resolve_cohort_shards,
+    shard_histogram,
+    shard_ids,
+    two_tier_weighted_average,
+)
 from ..fedcore.robust import (
     Z_AUTO_BETA,
     Z_AUTO_INIT,
     Z_AUTO_MARGIN,
     Z_AUTO_MAX,
     Z_AUTO_MIN,
-    Z_AUTO_Q,
     Z_EVIDENCE_REF,
-    _masked_vector_quantile,
     client_delta_norms,
     clip_update_norms,
     directional_scores,
@@ -62,6 +69,7 @@ from ..fedcore.robust import (
     parse_robust_spec,
     reputation_update,
     sanitize_updates,
+    trimmed_clean_basis,
     trust_bounded_work_frac,
     zscore_quarantine,
 )
@@ -130,7 +138,8 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                           participation=1.0, kernel_env=("", "", "", ""),
                           start_round=0, stop_round=None,
                           server_opt="none", server_lr=1.0,
-                          faults_on=False, robust_agg="mean"):
+                          faults_on=False, robust_agg="mean",
+                          hierarchy=False):
     # stop_round: required resolved int (the sole caller, _round_based,
     # always passes it; no None-resolution here so the cache cannot hold
     # duplicate programs for equivalent keys)
@@ -198,6 +207,22 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
     agg_spec = (dataclasses.replace(rspec, agg="mean", mkrum_m=0)
                 if sel_m is not None else rspec)
     aggregate_robust = make_robust_aggregator(agg_spec)
+
+    # Two-tier hierarchical reduction (fedcore.hierarchy, ROADMAP
+    # direction 2): with `hierarchy` set, every mean-family weighted
+    # reduction is re-associated into per-shard partial sums over a
+    # traced shard-id vector — the shard COUNT is data (a scalar jit
+    # argument), so changing --cohort_shards reuses the compiled
+    # program, and on a mesh the contiguous segments align with the
+    # client-axis placement (each partial sum is device-local, the
+    # cross-shard fold is the all-reduce GSPMD already emits). The
+    # order-statistic aggregators (median/trim/krum/geomed) fold
+    # globally by definition — their reduction stays flat; evidence
+    # (per-client norms/scores) is shard-local either way.
+    def reduce_mean(stacked, w, ids):
+        if hierarchy:
+            return two_tier_weighted_average(stacked, w, ids)
+        return weighted_average(stacked, w)
 
     def init_defense_state():
         """The cross-round defense state riding the scan carry —
@@ -296,10 +321,16 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 if zauto_on:
                     aux["z_threshold"] = z_ref
                     # fold this round's sub-threshold ("clean") scores
-                    # into the running quantile; an empty clean set
-                    # (degenerate round) leaves the estimate untouched
+                    # into the running estimate; the basis is
+                    # RISE-capped (robust.trimmed_clean_basis) so a
+                    # patient just-under-threshold attacker — the
+                    # clean MAX by construction — cannot ratchet the
+                    # threshold to Z_AUTO_MAX (the bounded-drift
+                    # contract, tests/test_reputation.py). An empty
+                    # clean set (degenerate round) leaves the estimate
+                    # untouched
                     clean = present * zok
-                    q_t = _masked_vector_quantile(z, clean, Z_AUTO_Q)
+                    q_t = trimmed_clean_basis(z, clean, dstate["zq"])
                     q_t = jnp.where(jnp.sum(clean) > 0, q_t,
                                     dstate["zq"])
                     new_state["zq"] = ((1.0 - Z_AUTO_BETA) * dstate["zq"]
@@ -324,16 +355,21 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
         return (stacked, losses, present, quar_t, aux, new_state,
                 work_frac)
 
-    def robust_round_aggregate(params, stacked, w_t, present):
+    def robust_round_aggregate(params, stacked, w_t, present, ids):
         """Clip + robust reduction + the all-absent no-op gate. The
         gate checks weight MASS for the mean aggregator (a learned p
         could put zero or negative total mass on the present set) and
         headcount for the order-statistic ones (which ignore weights).
         Returns ``(params, aux)`` — aux is the aggregator's defense
-        telemetry (krum selection / geomed residual)."""
+        telemetry (krum selection / geomed residual). Under the
+        hierarchy the mean reduction goes through the two-tier shard
+        partial sums (``ids`` is the traced shard assignment)."""
         if rspec.clip is not None:
             stacked = clip_update_norms(params, stacked, rspec.clip)
-        agg, aux = aggregate_robust(params, stacked, w_t, present)
+        if hierarchy and agg_spec.agg == "mean":
+            agg, aux = two_tier_weighted_average(stacked, w_t, ids), {}
+        else:
+            agg, aux = aggregate_robust(params, stacked, w_t, present)
         if agg_spec.agg == "mean":
             ok_round = jnp.sum(jnp.abs(w_t)) > 0
         else:
@@ -360,8 +396,13 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
         def train(seed, X, y, idx, mask, X_val, y_val,
                   X_test, y_test, lrs, p0, sizes, mu, lam,
                   params0=None, p_opt0=None, fault_rows=None,
-                  rep0=None, zq0=None):
+                  rep0=None, zq0=None, n_shards=None):
             keys, params = prologue(seed)
+            # traced shard assignment for the two-tier reduction: the
+            # shard count is DATA, so every --cohort_shards setting
+            # shares this compiled program (tests/test_hierarchy.py)
+            ids = (shard_ids(num_clients, n_shards) if hierarchy
+                   else None)
             if params0 is not None:  # resume / warm start
                 params = params0
             pkeys = jax.random.split(
@@ -461,7 +502,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                     w_t = participation_weights(
                         p_s, present, trust=dstate.get("rep"))
                     params, agg_aux = robust_round_aggregate(
-                        params, stacked, w_t, present)
+                        params, stacked, w_t, present, ids)
                     dfaux.update(agg_aux)
                 else:
                     quar_t = jnp.float32(0.0)
@@ -472,7 +513,13 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                         logits, y_val, p, opt_state, pkey_t, rounds,
                         client_valid=client_valid,
                     )
-                    params = weighted_average(stacked, p)
+                    params = reduce_mean(stacked, p, ids)
+                if hierarchy:
+                    # per-shard presence histogram — the round's
+                    # hierarchy telemetry (fixed (MAX_COHORT_SHARDS,)
+                    # shape; only the first n_shards rows are real)
+                    dfaux["shard_present"] = shard_histogram(
+                        present if fancy else client_valid, ids)
                 tl, ta = evaluate(params, X_test, y_test)
                 stream_metrics(t, train_loss_t, tl, ta)
                 ys = {"train_loss": train_loss_t, "test_loss": tl,
@@ -521,8 +568,11 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
     @jax.jit
     def train(seed, X, y, idx, mask, X_test, y_test, lrs,
               p_fixed, sizes, mu, lam, params0=None, server_opt0=None,
-              fault_rows=None, rep0=None, zq0=None):
+              fault_rows=None, rep0=None, zq0=None, n_shards=None):
         keys, params = prologue(seed)
+        # traced shard assignment (see the learned path): shard count
+        # is data, one compiled program per --cohort_shards sweep
+        ids = shard_ids(num_clients, n_shards) if hierarchy else None
         if params0 is not None:  # resume / warm start
             params = params0
         if aggregation == "nova":
@@ -582,7 +632,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                                             trust=dstate.get("rep"))
                 loss_w = participation_weights(p_fixed, present)
                 agg, agg_aux = robust_round_aggregate(
-                    params, stacked, w_t, present)
+                    params, stacked, w_t, present, ids)
                 dfaux.update(agg_aux)
                 train_loss_t = jnp.sum(loss_w * losses)
             elif participation < 1.0:
@@ -592,7 +642,7 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 ).astype(jnp.float32)
                 w_t = participation_weights(agg_w, part)
                 loss_w = participation_weights(p_fixed, part)
-                agg = weighted_average(stacked, w_t)
+                agg = reduce_mean(stacked, w_t, ids)
                 any_part = jnp.sum(part) > 0
                 # an all-absent round must also be a no-op for the
                 # server optimizer: keep agg == params (zero pseudo-
@@ -604,7 +654,12 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 train_loss_t = jnp.sum(loss_w * losses)
             else:
                 train_loss_t = jnp.sum(p_fixed * losses)
-                agg = weighted_average(stacked, agg_w)
+                agg = reduce_mean(stacked, agg_w, ids)
+            if hierarchy:
+                # per-shard presence histogram (hierarchy telemetry)
+                pres = (present if (faults_on or robust_on) else
+                        part if participation < 1.0 else valid)
+                dfaux["shard_present"] = shard_histogram(pres, ids)
             if server_tx is None:
                 params = agg
             else:
@@ -908,9 +963,25 @@ def _round_based(
     server_lr=1.0,
     faults=None,
     robust_agg="mean",
+    cohort_shards=0,
+    stream_cohort=False,
 ):
     """Common skeleton of FedAvg/FedProx/FedNova/FedAMW: scan over rounds
     of {local updates -> aggregate -> eval} (``tools.py:337-352``).
+
+    ``cohort_shards`` (the million-client cohort plane, ROADMAP
+    direction 2 / ``fedcore.hierarchy``) splits the client axis into
+    that many contiguous shards and routes every mean-family weighted
+    reduction through two-tier shard partial sums. The shard count is a
+    TRACED scalar: any value in ``[1, MAX_COHORT_SHARDS]`` reuses one
+    compiled program, and the aggregate matches the flat reduction up
+    to float re-association while every quarantine/gating decision is
+    bit-identical (the per-client evidence never changes). With
+    ``stream_cohort=True`` the cohort no longer rides one jitted scan:
+    client shards stream host->device double-buffered
+    (``data.stream.CohortShardStream``) through one compiled shard-tier
+    program per round, so cohort size is bounded by host RAM, not HBM —
+    see :func:`_streamed_round_based` for the supported surface.
 
     ``faults`` (None | spec string | FaultSpec | FaultPlan) injects
     deterministic client faults per round (``fedcore.faults``);
@@ -947,6 +1018,35 @@ def _round_based(
     if not 0.0 < participation <= 1.0:
         raise ValueError(f"participation must be in (0, 1], got "
                          f"{participation}")
+    n_cohort_shards = resolve_cohort_shards(
+        cohort_shards, setup.num_clients, streamed=bool(stream_cohort))
+    if stream_cohort:
+        if n_cohort_shards == 0:
+            raise ValueError(
+                "stream_cohort=True needs cohort_shards >= 1 (the "
+                "host->device shard size is the streaming knob)")
+        if aggregation == "learned":
+            raise ValueError(
+                "stream_cohort=True does not compose with FedAMW's "
+                "learned mixture weights yet: the p-solve consumes the "
+                "(n_val, J, C) logit tensor globally, which is exactly "
+                "the O(J) x O(n_val C) buffer streaming exists to "
+                "avoid — use in-graph cohort_shards for FedAMW "
+                "(ROADMAP follow-on)")
+        return _streamed_round_based(
+            setup, aggregation, lr, epoch, batch_size, rounds, mu, lam,
+            n_cohort_shards, seed=seed, lr_mode=lr_mode,
+            verbose=verbose, return_state=return_state,
+            participation=participation, sequential=sequential,
+            start_round=start_round, stop_round=stop_round,
+            resume_from=resume_from, server_opt=server_opt,
+            analyze_memory=analyze_memory,
+            faults=faults, robust_agg=robust_agg)
+    hierarchy_on = n_cohort_shards > 0
+    if hierarchy_on and setup.mesh_devices > 1:
+        from ..parallel.mesh import validate_cohort_alignment
+
+        validate_cohort_alignment(n_cohort_shards, setup.mesh_devices)
     if aggregation == "learned" and server_opt != "none":
         raise ValueError(
             "FedAMW aggregates with LEARNED mixture weights; composing "
@@ -991,7 +1091,7 @@ def _round_based(
         aggregation, lr_p, val_batch_size, n_val, sequential,
         setup.mesh_devices, verbose, float(participation), _kernel_env(),
         int(start_round), stop, server_opt, float(server_lr),
-        faults_on, robust_canonical,
+        faults_on, robust_canonical, hierarchy_on,
     )
     global _LAST_TRAIN_FN
     _LAST_TRAIN_FN = train
@@ -1114,16 +1214,21 @@ def _round_based(
     # the plan rows ride the dispatch like the LR schedule: sliced from
     # the full horizon, so prefix + resume replays identical faults
     fault_rows = plan.rows(start_round, stop) if faults_on else None
+    # the traced shard count rides the dispatch as a scalar argument —
+    # data, not program structure, so a --cohort_shards sweep reuses
+    # one compiled program (None keeps the default graph bit-identical
+    # to a build without the hierarchy)
+    n_shards = (jnp.int32(n_cohort_shards) if hierarchy_on else None)
     if aggregation == "learned":
         args = (seed, setup.X, setup.y, idx_tup, mask_tup,
                 setup.X_val, setup.y_val, setup.X_test, setup.y_test,
                 lrs, p0, setup.sizes, float(mu), float(lam), params0,
-                opt0, fault_rows, rep0, zq0)
+                opt0, fault_rows, rep0, zq0, n_shards)
     else:
         args = (seed, setup.X, setup.y, idx_tup, mask_tup,
                 setup.X_test, setup.y_test, lrs,
                 p0, setup.sizes, float(mu), float(lam), params0, opt0,
-                fault_rows, rep0, zq0)
+                fault_rows, rep0, zq0, n_shards)
 
     if analyze_memory:
         # AOT device-memory report for the WHOLE fused training program
@@ -1188,6 +1293,16 @@ def _round_based(
         defense["krum_pick_counts"] = sel.sum(axis=0)
     if "geomed_residual" in metrics:
         defense["geomed_residual"] = metrics["geomed_residual"]
+    if hierarchy_on:
+        # hierarchy telemetry: the per-round per-shard presence
+        # histogram, sliced to the REAL shard count (the in-graph
+        # partial buffers are statically MAX_COHORT_SHARDS wide)
+        out["hierarchy"] = {
+            "cohort_shards": n_cohort_shards,
+            "shard_present": np.rint(
+                metrics["shard_present"][:, :n_cohort_shards]
+            ).astype(int),
+        }
     if defense:
         defense["robust_agg"] = robust_canonical
         # inert padded clients (mesh-even packing) are never present,
@@ -1222,6 +1337,169 @@ def _round_based(
             # carry-to-checkpoint contract as reputation (save via
             # save_checkpoint(defense_state={'zq': res['zq']}))
             out["zq"] = metrics["zq"][-1]
+    return out
+
+
+# Introspection hook for the STREAMED cohort tier (the twin of
+# _LAST_TRAIN_FN): the jitted shard-tier program the most recent
+# streamed run dispatched, so tests and the scale bench can pin its
+# XLA cache size across shards, rounds, fault plans, and shard counts.
+_LAST_SHARD_TIER = None
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_shard_tier(apply_fn, task, epoch, batch_size, n_max,
+                       aggregation, faults_on, clip, zscore,
+                       kernel_env=("", "", "", "")):
+    """Memoized streamed shard tier + evaluator: ONE compiled program
+    serves every shard of every round of every same-config run (the
+    streamed zero-recompile contract; shard shapes are static, shard
+    contents are data)."""
+    round_fn = make_client_round(apply_fn, task, epoch, batch_size,
+                                 n_max)
+    tier = make_shard_tier(round_fn, epoch, batch_size, aggregation,
+                           faults_on, clip, zscore)
+    evaluate = jax.jit(make_evaluator(apply_fn, task))
+    return tier, evaluate
+
+
+def _streamed_round_based(setup, aggregation, lr, epoch, batch_size,
+                          rounds, mu, lam, n_shards, seed=0,
+                          lr_mode="reference", verbose=False,
+                          return_state=False, participation=1.0,
+                          sequential=False, start_round=0,
+                          stop_round=None, resume_from=None,
+                          server_opt="none", analyze_memory=False,
+                          faults=None, robust_agg="mean"):
+    """The streamed cohort driver (``stream_cohort=True``): a host
+    round loop over ``data.stream.CohortShardStream``'s double-buffered
+    client shards, each run through ONE compiled
+    ``fedcore.hierarchy.make_shard_tier`` program emitting a
+    fixed-shape :class:`~fedcore.hierarchy.ShardSummary`;
+    ``fold_summaries`` is the global tier. Cohort size is bounded by
+    host RAM (the ``O(J)`` index/key/fault rows), not HBM.
+
+    Supported surface (everything else is refused loudly — a silently
+    narrowed run must not masquerade as the flat semantics): the
+    fixed-weight aggregations with mean-family defenses
+    (``clip:R``/``quarantine:Z`` — evidence is SHARD-LOCAL under
+    streaming, the hierarchy's locality contract), full participation,
+    parallel client semantics, single-pack layout, no server
+    optimizer, no resume segmentation. The learned path and the
+    stateful/order-statistic defenses need the in-graph
+    ``cohort_shards`` mode (global statistics).
+    """
+    from ..data.stream import CohortShardStream
+
+    if sequential:
+        raise ValueError(
+            "stream_cohort=True cannot compose with sequential=True "
+            "(the contamination chain threads one model through every "
+            "client in order; shards stream independently)")
+    if participation < 1.0:
+        raise ValueError(
+            "stream_cohort=True does not support participation<1 yet; "
+            "model dropout through the fault plane's drop= instead")
+    if server_opt != "none":
+        raise ValueError(
+            "stream_cohort=True does not compose with a FedOpt server "
+            "optimizer yet (server_opt applies to the flat and "
+            "in-graph paths)")
+    if start_round != 0 or stop_round is not None or resume_from is not None:
+        raise ValueError(
+            "stream_cohort=True does not support segmented/resumed "
+            "runs yet (start_round/stop_round/resume_from)")
+    if analyze_memory:
+        raise ValueError(
+            "analyze_memory reports one fused program's AOT footprint; "
+            "the streamed path is a host loop over shard programs — "
+            "measure the shard tier directly instead")
+    if setup.bucket_idx is not None:
+        raise ValueError(
+            "stream_cohort=True needs the single-pack layout "
+            "(prepare_setup(buckets=1)): the bucketed view re-sorts "
+            "clients and has per-bucket shapes, so contiguous "
+            "equal-shape shards cannot be sliced from it")
+    rspec = parse_robust_spec(robust_agg)
+    if (rspec.agg != "mean" or rspec.rep_decay is not None
+            or rspec.zscore_auto):
+        raise ValueError(
+            f"stream_cohort=True supports the mean-family defenses "
+            f"(clip:R, quarantine:Z) whose evidence is shard-local; "
+            f"robust_agg={rspec.canonical()!r} needs global statistics "
+            "— use the in-graph cohort_shards mode")
+
+    J = setup.num_clients
+    stream = CohortShardStream(
+        n_shards, idx=np.asarray(setup.idx), mask=np.asarray(setup.mask),
+        sizes=np.asarray(setup.sizes),
+        p_fixed=np.asarray(setup.p_fixed))
+    plan = resolve_fault_plan(faults, rounds, J)
+    faults_on = plan is not None
+    n_max = int(setup.idx.shape[1])
+    tier, evaluate = _cached_shard_tier(
+        setup.model.apply, setup.task, epoch, batch_size, n_max,
+        aggregation, faults_on,
+        rspec.clip, rspec.zscore, _kernel_env())
+    global _LAST_SHARD_TIER
+    _LAST_SHARD_TIER = tier
+
+    params = _derive_params(setup.model.init, seed, setup.D,
+                            setup.num_classes)
+    lrs = lr_schedule_array(lr, rounds, lr_mode)
+    # the same per-round key stream as the flat path, host-resident:
+    # (rounds, J, 2) uint32 rows stream with their shard
+    kall = np.asarray(_keys(seed, rounds, J))
+    mu_f, lam_f = float(mu), float(lam)
+
+    tls, tes, tas, quars, pres = [], [], [], [], []
+    t_scan0 = time.perf_counter()
+    for t in range(rounds):
+        fr = (tuple(a[t] for a in (plan.drop, plan.scale, plan.poison,
+                                   plan.fill, plan.report))
+              if faults_on else None)
+        summaries = []
+        for _s, shard in stream.round_shards(kall[t], fault_rows=fr):
+            summaries.append(tier(
+                params, setup.X, setup.y, shard["idx"], shard["mask"],
+                shard["keys"], jnp.float32(lrs[t]), mu_f, lam_f,
+                shard["sizes"], shard["p_fixed"],
+                shard.get("fault_rows")))
+        params, tr_loss, n_pres, n_q = fold_summaries(
+            params, summaries, aggregation)
+        tl, ta = evaluate(params, setup.X_test, setup.y_test)
+        tls.append(float(tr_loss))
+        tes.append(float(tl))
+        tas.append(float(ta))
+        quars.append(float(n_q))
+        pres.append(float(n_pres))
+        if verbose:
+            _print_round(t, tls[-1], tes[-1], tas[-1])
+    scan_s = time.perf_counter() - t_scan0
+
+    metrics = {"train_loss": np.asarray(tls), "test_loss": np.asarray(tes),
+               "test_acc": np.asarray(tas)}
+    out = result_tuple(metrics["train_loss"], metrics["test_loss"],
+                       metrics["test_acc"])
+    out["streamed"] = {
+        "cohort_shards": stream.n_shards,
+        "shard_clients": stream.shard_clients,
+        "present": np.asarray(pres),
+    }
+    if faults_on:
+        valid_np = (np.asarray(setup.sizes) > 0).astype(np.float64)
+        out["fault_counts"] = {
+            "dropped": (plan.drop * valid_np).sum(1).astype(int),
+            "straggled": (plan.straggle * valid_np).sum(1).astype(int),
+            "corrupted": (plan.corrupt * valid_np).sum(1).astype(int),
+            "lied": (plan.lie * valid_np).sum(1).astype(int),
+            "quarantined": np.rint(np.asarray(quars)).astype(int),
+        }
+    _emit_round_spans(out, metrics, aggregation, rspec.canonical(),
+                      faults_on, 0, rounds, t_scan0, scan_s)
+    if return_state:
+        out["params"] = params
+        out["p"] = setup.p_fixed
     return out
 
 
@@ -1294,6 +1572,8 @@ def FedAvg(
     server_lr=1.0,
     faults=None,
     robust_agg="mean",
+    cohort_shards=0,
+    stream_cohort=False,
     **_,
 ):
     """Standard FedAvg (``tools.py:329-353``)."""
@@ -1308,6 +1588,7 @@ def FedAvg(
         resume_from=resume_from,
         server_opt=server_opt, server_lr=server_lr,
         faults=faults, robust_agg=robust_agg,
+        cohort_shards=cohort_shards, stream_cohort=stream_cohort,
     )
 
 
@@ -1335,6 +1616,8 @@ def FedProx(
     server_lr=1.0,
     faults=None,
     robust_agg="mean",
+    cohort_shards=0,
+    stream_cohort=False,
     **_,
 ):
     """FedAvg skeleton + proximal term (``tools.py:356-380``)."""
@@ -1349,6 +1632,7 @@ def FedProx(
         resume_from=resume_from,
         server_opt=server_opt, server_lr=server_lr,
         faults=faults, robust_agg=robust_agg,
+        cohort_shards=cohort_shards, stream_cohort=stream_cohort,
     )
 
 
@@ -1376,6 +1660,8 @@ def FedNova(
     server_lr=1.0,
     faults=None,
     robust_agg="mean",
+    cohort_shards=0,
+    stream_cohort=False,
     **_,
 ):
     """Normalized averaging (``tools.py:383-410``)."""
@@ -1390,6 +1676,7 @@ def FedNova(
         resume_from=resume_from,
         server_opt=server_opt, server_lr=server_lr,
         faults=faults, robust_agg=robust_agg,
+        cohort_shards=cohort_shards, stream_cohort=stream_cohort,
     )
 
 
@@ -1419,6 +1706,8 @@ def FedAMW(
     server_lr=1.0,
     faults=None,
     robust_agg="mean",
+    cohort_shards=0,
+    stream_cohort=False,
     **_,
 ):
     """The paper's algorithm (``tools.py:413-463``): ridge-regularized
@@ -1445,4 +1734,5 @@ def FedAMW(
         resume_from=resume_from,
         server_opt=server_opt, server_lr=server_lr,
         faults=faults, robust_agg=robust_agg,
+        cohort_shards=cohort_shards, stream_cohort=stream_cohort,
     )

@@ -2,6 +2,7 @@ from .datasets import FederatedDataset, load_dataset
 from .loaders import MinibatchLoader, load_data
 from .pack import ClientPack, pack_partitions, split_train_val
 from .partition import dirichlet_partition, uniform_partition
+from .stream import CohortShardStream
 from .svmlight import canonicalize_labels, is_regression, load_svmlight
 from .synthetic import generate_synthetic, synthetic_classification
 
@@ -11,6 +12,7 @@ __all__ = [
     "MinibatchLoader",
     "load_data",
     "ClientPack",
+    "CohortShardStream",
     "pack_partitions",
     "split_train_val",
     "dirichlet_partition",

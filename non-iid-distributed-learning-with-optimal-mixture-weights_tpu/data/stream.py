@@ -1,0 +1,96 @@
+"""Host->device double-buffered client-shard streaming.
+
+The streamed half of the cohort-scale plane (``fedcore.hierarchy``):
+when the stacked client axis no longer fits next to the model in HBM,
+the ``O(J)`` per-client rows — packed index sets, validity masks, PRNG
+keys, sizes, fixed weights, and the round's fault-plan rows — live on
+the HOST, and each round walks the cohort in ``n_shards`` contiguous
+equal shards. :class:`CohortShardStream` slices shard ``s`` host-side
+and issues its ``jax.device_put`` while shard ``s-1`` is still
+computing (``device_put`` is asynchronous on real backends), so the
+transfer of the next shard hides behind the compute of the current one
+— classic double buffering, one shard of lookahead, at most two
+shards' rows resident on device at any time.
+
+Cohort size is then bounded by host RAM (the ``O(J)`` rows; ~40 bytes
+per client per round at n_max=4) rather than HBM (one shard's stacked
+client params), which is what takes the simulator to 1M clients per
+round (``scale_bench.py``'s ``cohort`` leg).
+
+Shards are CONTIGUOUS and equal-sized by construction (``J`` must
+divide evenly; pad the cohort with inert empty clients via
+``prepare_setup(client_multiple=n_shards)`` otherwise) so every shard
+shares ONE compiled shard-tier program — shard shapes are static,
+shard contents are data.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+class CohortShardStream:
+    """Double-buffered iterator over contiguous client shards.
+
+    ``idx``/``mask`` are the single-pack ``(J, n_max)`` client rows
+    (``data.pack.pack_partitions``; the bucketed layout re-sorts
+    clients and has per-bucket shapes, so streaming requires
+    ``buckets=1``), ``sizes``/``p_fixed`` the ``(J,)`` per-client
+    vectors. All are kept host-side as numpy; nothing ``O(J)`` is ever
+    resident on device in full.
+    """
+
+    def __init__(self, n_shards: int, idx, mask, sizes, p_fixed):
+        self.idx = np.asarray(idx)
+        self.mask = np.asarray(mask)
+        self.sizes = np.asarray(sizes)
+        self.p_fixed = np.asarray(p_fixed)
+        J = self.idx.shape[0]
+        if n_shards < 1:
+            raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+        if J % n_shards != 0:
+            raise ValueError(
+                f"the {J}-client cohort does not divide into "
+                f"{n_shards} equal shards; pad with inert empty "
+                f"clients (prepare_setup(client_multiple={n_shards})) "
+                "so every shard shares one compiled program")
+        self.n_shards = int(n_shards)
+        self.shard_clients = J // self.n_shards
+
+    @property
+    def num_clients(self) -> int:
+        return self.idx.shape[0]
+
+    def _put(self, s: int, keys, fault_rows):
+        """Slice shard ``s`` host-side and start its async transfer."""
+        sl = slice(s * self.shard_clients, (s + 1) * self.shard_clients)
+        out = {
+            "idx": jax.device_put(self.idx[sl]),
+            "mask": jax.device_put(self.mask[sl]),
+            "sizes": jax.device_put(self.sizes[sl]),
+            "p_fixed": jax.device_put(self.p_fixed[sl]),
+            "keys": jax.device_put(keys[sl]),
+        }
+        if fault_rows is not None:
+            out["fault_rows"] = tuple(
+                jax.device_put(np.asarray(r)[sl]) for r in fault_rows)
+        return out
+
+    def round_shards(self, keys, fault_rows=None):
+        """Yield ``(s, shard_dict)`` for one round, with one shard of
+        device-transfer lookahead.
+
+        ``keys`` is the round's ``(J, ...)`` per-client PRNG key array
+        (host numpy); ``fault_rows`` the round's per-client fault-plan
+        row tuple (``FaultPlan.rows`` layout: drop/scale/poison/fill/
+        tau_frac, each ``(J,)``) or None for a clean round. The yielded
+        dict holds device arrays for exactly one shard.
+        """
+        keys = np.asarray(keys)
+        buf = self._put(0, keys, fault_rows)
+        for s in range(self.n_shards):
+            nxt = (self._put(s + 1, keys, fault_rows)
+                   if s + 1 < self.n_shards else None)
+            yield s, buf
+            buf = nxt

@@ -42,6 +42,27 @@ def weighted_average(stacked_params, p: jax.Array):
     )
 
 
+def segment_weighted_sums(stacked_params, p: jax.Array, ids: jax.Array,
+                          num_segments: int):
+    """Per-shard partial weighted sums: leaf ``j`` of shape ``(J, ...)``
+    becomes ``(num_segments, ...)`` where row ``s`` holds
+    ``sum_{j: ids_j == s} p_j * theta_j`` — the shard tier of the
+    two-tier hierarchical reduction (``fedcore.hierarchy``).
+
+    ``num_segments`` is STATIC (it shapes the partial buffers); ``ids``
+    is a traced ``(J,)`` int32 vector, so the shard ASSIGNMENT — and
+    with it the shard count — is data, never program structure. Folding
+    the partials over their leading axis reproduces
+    :func:`weighted_average` up to float re-association.
+    """
+    return jax.tree.map(
+        lambda w: jax.ops.segment_sum(
+            w * p.reshape((p.shape[0],) + (1,) * (w.ndim - 1)),
+            ids, num_segments=num_segments),
+        stacked_params,
+    )
+
+
 def fednova_effective_weights(
     sizes: jax.Array, p: jax.Array, epochs: int, batch_size: int,
     tau_frac: jax.Array | None = None,

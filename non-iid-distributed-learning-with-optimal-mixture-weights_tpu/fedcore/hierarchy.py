@@ -1,0 +1,278 @@
+"""Two-tier hierarchical aggregation over a sharded client axis.
+
+The cohort-scale plane (ROADMAP direction 2): today's round trainers
+vmap ONE global cohort and reduce it with a single weighted
+``tensordot`` — cohort size is bounded by what fits next to the model
+on one device. This module splits the client axis into shards and the
+round's server work into two tiers, the hierarchical extension of
+FedAvg's fixed ``n_j/n`` weighting (PAPERS.md #1) and of Krum-style
+robust selection (PAPERS.md #5):
+
+- **shard tier**: each shard of ``J/S`` clients computes its own
+  evidence (delta norms, finite-ness, shard-local z-scores under
+  streaming) and *pre-aggregates* its clients into fixed-shape shard
+  summaries — a weighted partial parameter sum plus a handful of
+  scalar masses. Per-shard work is ``O(J/S)``; a summary is ``O(P)``
+  regardless of how many clients the shard holds.
+- **global tier**: folds the shard summaries — ``psum``-style partial
+  sums for the fixed-weight algorithms, the global present mask /
+  trusted weights / masked FedAMW ``p``-solve for the learned one —
+  and emits the round's aggregate. The fold touches ``O(S · P)``
+  partials and ``O(J)`` score vectors, never ``O(J · P)`` stacked
+  parameters.
+
+Two composition modes share this machinery:
+
+**In-graph sharding** (``cohort_shards=S`` on the round trainers): the
+stacked ``(J, ...)`` client axis stays inside the one jitted round
+scan, and the weighted reduction is re-associated into per-shard
+partial sums via ``segment_sum`` over a traced shard-id vector. The
+shard COUNT is *data*, not program structure: partial buffers are
+statically ``(MAX_COHORT_SHARDS, ...)``-shaped and the ids are
+computed from a traced scalar, so changing ``--cohort_shards`` reuses
+the same compiled program — the zero-recompile contract extends to
+shard counts (``tests/test_hierarchy.py``). On a mesh the segment
+boundaries align with the client-axis placement
+(``parallel.shard_setup``), so each device's partial sum is local and
+the cross-shard fold is the ICI all-reduce GSPMD already emits —
+explicit two-tier structure and the pjit model agree. Evidence
+(norms, z-scores, reputation) is computed per client exactly as in
+the flat path — per-client reductions are embarrassingly shard-local
+— and the global-tier statistics (median/MAD, quantiles) fold over
+the concatenated ``(J,)`` score vectors, so quarantine and gating
+DECISIONS are bit-identical to the single-device path while the
+re-associated aggregate matches to float tolerance.
+
+**Streamed sharding** (``stream_cohort=True``): the cohort no longer
+fits on device at all — ``data.stream.CohortShardStream`` double-
+buffers client shards host->device and :func:`make_shard_tier`'s one
+compiled program runs per shard, emitting a :class:`ShardSummary`;
+:func:`fold_summaries` is the global tier. Cohort size is then
+bounded by host RAM (the ``O(J)`` index/key/fault rows), not HBM (one
+shard's stacked params). Statistics under streaming are SHARD-LOCAL
+by construction (the z-test's median/MAD come from the shard's own
+clients — at streaming scale a shard holds thousands of clients, so
+the shard statistics are excellent estimators of the cohort's); the
+in-graph mode keeps exact global statistics. The streamed driver is
+``algorithms.core._streamed_round_based``.
+
+FedAMW under in-graph sharding: the masked ``p``-solve is global-tier
+work by definition — it consumes per-client validation logits
+(computed shard-locally by the vmapped ``client_logits``; the
+``(B, J, C) x (J,)`` mixture contraction partial-reduces per shard
+and ``psum``s ``(B, C)`` partials under GSPMD) and the globally
+folded present mask, so quarantined/gated/deselected clients keep
+exactly zero learned mass with no new code path. The final aggregate
+with the learned ``p`` goes through the same two-tier partial sums as
+the fixed weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .aggregate import segment_weighted_sums
+from .faults import inject_fault_row
+from .robust import (clip_update_norms, client_delta_norms,
+                     sanitize_updates, zscore_quarantine)
+
+#: Static capacity of the shard axis for IN-GRAPH sharding: partial
+#: buffers are (MAX_COHORT_SHARDS, ...)-shaped so the shard count is a
+#: traced scalar (data), never a shape — one compiled program covers
+#: every --cohort_shards setting. 64 covers a pod slice's hosts;
+#: streamed sharding has no such cap (the shard loop is host-side).
+MAX_COHORT_SHARDS = 64
+
+
+def resolve_cohort_shards(cohort_shards: int, num_clients: int,
+                          streamed: bool = False) -> int:
+    """Host-side validation of the ``cohort_shards`` knob: 0 disables
+    the hierarchy (the exact flat graph); otherwise the count must fit
+    the cohort, and in-graph sharding must also fit the static
+    ``MAX_COHORT_SHARDS`` partial-buffer capacity."""
+    s = int(cohort_shards)
+    if s < 0:
+        raise ValueError(f"cohort_shards must be >= 0, got {s}")
+    if s == 0:
+        return 0
+    if s > num_clients:
+        raise ValueError(
+            f"cohort_shards={s} exceeds the cohort ({num_clients} "
+            f"clients); a shard needs at least one client")
+    if not streamed and s > MAX_COHORT_SHARDS:
+        raise ValueError(
+            f"cohort_shards={s} exceeds MAX_COHORT_SHARDS="
+            f"{MAX_COHORT_SHARDS} for in-graph sharding; use "
+            f"stream_cohort=True for host-loop shard counts")
+    return s
+
+
+def shard_ids(num_clients: int, n_shards) -> jax.Array:
+    """Contiguous balanced shard assignment: client ``j`` belongs to
+    shard ``floor(j * S / J)`` — ``(J,)`` int32, traced from the
+    scalar ``n_shards`` (changing the shard count never recompiles).
+    Contiguity matters on a mesh: it aligns shard boundaries with the
+    client-axis device placement, keeping each partial sum local."""
+    j = jnp.arange(num_clients, dtype=jnp.int32)
+    return (j * jnp.int32(n_shards)) // jnp.int32(num_clients)
+
+
+def two_tier_weighted_average(stacked, w: jax.Array, ids: jax.Array):
+    """``sum_j w_j theta_j`` re-associated into shard partial sums —
+    the numerically explicit form of the hierarchical reduction (shard
+    tier: ``segment_sum`` into ``(MAX_COHORT_SHARDS, ...)`` partials;
+    global tier: fold over the shard axis). Matches
+    ``aggregate.weighted_average`` to float tolerance — re-association
+    is the only difference — and is what a mesh executes as local
+    partial reduce + cross-device ``psum``."""
+    partials = segment_weighted_sums(stacked, w, ids, MAX_COHORT_SHARDS)
+    return jax.tree.map(lambda p: jnp.sum(p, axis=0), partials)
+
+
+def shard_histogram(v: jax.Array, ids: jax.Array) -> jax.Array:
+    """Per-shard totals of a ``(J,)`` vector — ``(MAX_COHORT_SHARDS,)``
+    — the round's hierarchy telemetry (present clients per shard,
+    quarantines per shard, weight mass per shard)."""
+    return jax.ops.segment_sum(v, ids, num_segments=MAX_COHORT_SHARDS)
+
+
+# -- streamed shard tier ----------------------------------------------
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ShardSummary:
+    """Fixed-shape output of one streamed shard's tier-1 work. Every
+    field is ``O(P)`` or ``O(1)`` — the stacked ``(J_s, P)`` client
+    params never leave the shard tier.
+
+    ``partial`` holds ``sum_{j in shard} u_j present_j theta_j`` where
+    ``u`` is the algorithm's UNNORMALIZED per-client weight (FedAvg/
+    FedProx: the fixed sample-count weight; FedNova: ``p_j / tau_j``,
+    whose global ``tau_eff`` factor is a scalar the fold applies).
+    The scalar masses are what the global tier needs to renormalize
+    over the cohort-wide present set exactly as
+    ``aggregate.participation_weights`` does."""
+
+    partial: Any            # pytree, leaves (P-shaped) partial sums
+    u_all: jax.Array        # sum of u over the shard's real clients
+    u_present: jax.Array    # sum of u over the shard's present set
+    tau_p: jax.Array        # sum of tau_j p_j (FedNova's tau_eff part)
+    loss_num: jax.Array     # sum of p_fixed_j present_j loss_j
+    p_all: jax.Array        # sum of p_fixed over real clients
+    p_present: jax.Array    # sum of p_fixed over the present set
+    n_present: jax.Array    # present-client count
+    n_quarantined: jax.Array  # non-finite + z-quarantined count
+
+
+def make_shard_tier(round_fn, epochs: int, batch_size: int,
+                    aggregation: str, faults_on: bool,
+                    clip: float | None, zscore: float | None):
+    """Build the jitted per-shard tier for STREAMED rounds.
+
+    ``shard_tier(params, X, y, idx_s, mask_s, keys_s, lr, mu, lam,
+    sizes_s, p_fixed_s, fault_rows_s) -> ShardSummary`` runs the
+    shard's local updates, injects its slice of the fault plan,
+    sanitizes, clips, applies the SHARD-LOCAL z-quarantine (the
+    shard's own median/MAD — the hierarchy's locality contract; at
+    streaming scale a shard's thousands of clients estimate the
+    cohort statistics well), and pre-aggregates into a fixed-shape
+    summary. One compiled program serves every shard of every round —
+    shard shapes are static, plan rows and keys are data.
+    """
+    nova = aggregation == "nova"
+
+    @jax.jit
+    def shard_tier(params, X, y, idx_s, mask_s, keys_s, lr_t, mu, lam,
+                   sizes_s, p_fixed_s, fault_rows_s=None):
+        stacked, losses, _ = round_fn(params, X, y, idx_s, mask_s,
+                                      keys_s, lr_t, mu, lam)
+        present = (sizes_s > 0).astype(jnp.float32)
+        work_frac = None
+        if faults_on:
+            f_drop, f_scale, f_poison, f_fill, f_tau = fault_rows_s
+            stacked, losses = inject_fault_row(
+                params, stacked, losses, f_scale, f_poison, f_fill)
+            present = present * (1.0 - f_drop)
+            work_frac = f_tau
+        reported = present
+        stacked, losses, ok = sanitize_updates(params, stacked, losses)
+        present = present * ok
+        quar = jnp.sum(reported * (1.0 - ok))
+        if zscore is not None:
+            norms = client_delta_norms(params, stacked)
+            zok, _z = zscore_quarantine(params, stacked, present,
+                                        jnp.float32(zscore),
+                                        work_frac=work_frac,
+                                        norms=norms)
+            quar = quar + jnp.sum(present * (1.0 - zok))
+            present = present * zok
+        if clip is not None:
+            stacked = clip_update_norms(params, stacked, clip)
+        valid = (sizes_s > 0).astype(jnp.float32)
+        if nova:
+            tau = sizes_s.astype(jnp.float32) * epochs / batch_size
+            if work_frac is not None:
+                tau = tau * work_frac
+            safe = jnp.where(tau > 0, tau, 1.0)
+            u = jnp.where(tau > 0, p_fixed_s / safe, 0.0)
+            tau_p = jnp.sum(tau * p_fixed_s)
+        else:
+            u = p_fixed_s * valid
+            tau_p = jnp.float32(0.0)
+        partial = jax.tree.map(
+            lambda s: jnp.tensordot(u * present, s, axes=(0, 0)),
+            stacked)
+        return ShardSummary(
+            partial=partial,
+            u_all=jnp.sum(u * valid),
+            u_present=jnp.sum(u * present),
+            tau_p=tau_p,
+            loss_num=jnp.sum(p_fixed_s * present * losses),
+            p_all=jnp.sum(p_fixed_s * valid),
+            p_present=jnp.sum(p_fixed_s * present),
+            n_present=jnp.sum(present),
+            n_quarantined=quar,
+        )
+
+    return shard_tier
+
+
+def fold_summaries(params, summaries: list[ShardSummary],
+                   aggregation: str):
+    """The streamed GLOBAL tier: fold the shards' fixed-shape
+    summaries into the round's aggregate and train loss.
+
+    The fold reproduces ``participation_weights``' cohort-wide
+    renormalization from the shard masses alone: the final per-client
+    weight is ``u_j present_j * (sum u_all / sum u_present)`` (times
+    FedNova's global ``tau_eff = sum tau_j p_j``), so the aggregate is
+    the folded partial sums times two global scalars. An all-absent
+    round keeps the incoming params (the flat path's no-op gate).
+
+    Returns ``(new_params, train_loss, n_present, n_quarantined)``.
+    """
+    partial = summaries[0].partial
+    for s in summaries[1:]:
+        partial = jax.tree.map(jnp.add, partial, s.partial)
+    u_all = sum(s.u_all for s in summaries)
+    u_present = sum(s.u_present for s in summaries)
+    loss_num = sum(s.loss_num for s in summaries)
+    p_all = sum(s.p_all for s in summaries)
+    p_present = sum(s.p_present for s in summaries)
+    n_present = sum(s.n_present for s in summaries)
+    n_quar = sum(s.n_quarantined for s in summaries)
+    scale = jnp.where(u_present > 0,
+                      u_all / jnp.maximum(u_present, 1e-30), 0.0)
+    if aggregation == "nova":
+        scale = scale * sum(s.tau_p for s in summaries)
+    ok_round = u_present > 0
+    new_params = jax.tree.map(
+        lambda part, old: jnp.where(ok_round, scale * part, old),
+        partial, params)
+    loss_scale = jnp.where(p_present > 0,
+                           p_all / jnp.maximum(p_present, 1e-30), 0.0)
+    return new_params, loss_scale * loss_num, n_present, n_quar

@@ -146,17 +146,32 @@ FRAC_MARGIN = 2.0
 
 # -- quarantine:auto threshold estimator ------------------------------
 # threshold = clip(Z_AUTO_MARGIN * m, Z_AUTO_MIN, Z_AUTO_MAX) where m
-# is a running (EWMA, rate Z_AUTO_BETA) Z_AUTO_Q-quantile of the
-# OBSERVED sub-threshold ("clean") z scores, carried in the scan
+# is a running (EWMA, rate Z_AUTO_BETA) estimate of the top of the
+# OBSERVED sub-threshold ("clean") z distribution, carried in the scan
 # state. m starts at Z_AUTO_INIT, placing the initial threshold at the
 # hand-tuned Z=5 operating point (README: honest digits clients top
 # out near z ~ 3.3, a 25x attacker lands at z > 50).
+#
+# The per-round basis is RISE-capped (:func:`trimmed_clean_basis`):
+# the raw clean max may pull the estimate DOWN freely, but may not
+# raise it past max(carried estimate, Z_AUTO_TRIM_GAP x the
+# SECOND-largest clean score). A patient attacker that parks its z
+# just under the current threshold every round is, by construction,
+# the largest "clean" score — with an untrimmed max basis it drags the
+# running estimate up each round and the threshold ratchets toward
+# Z_AUTO_MAX (the drift the ROADMAP carried follow-on names). Under
+# the cap its upward pull is bounded by the gap over the honest
+# runner-up, so the threshold stays at most Z_AUTO_MARGIN *
+# max(initial, Z_AUTO_TRIM_GAP x honest maximum) instead of ratcheting
+# without bound. An honest cohort keeps the pre-trim dynamics: a clean
+# max at or below the carried estimate passes through raw.
 Z_AUTO_INIT = 10.0 / 3.0
 Z_AUTO_MARGIN = 1.5
 Z_AUTO_MIN = 3.0
 Z_AUTO_MAX = 20.0
 Z_AUTO_BETA = 0.1
-Z_AUTO_Q = 1.0  # the running max of the clean z distribution
+Z_AUTO_Q = 1.0  # the quantile of the clean basis (1 = the clean max)
+Z_AUTO_TRIM_GAP = 1.5  # cap: basis <= gap * second-largest clean z
 
 # set (by conftest) to make every parse_robust_spec call verify the
 # canonical round-trip contract: parse(canonical(parse(s))) == parse(s)
@@ -448,6 +463,46 @@ def _masked_vector_quantile(v: jax.Array, present: jax.Array,
     idx = jnp.clip(J - n + k - 1, 0, J - 1)
     s = jnp.sort(jnp.where(present > 0, v, -jnp.inf))
     return s[idx]
+
+
+def trimmed_clean_basis(z: jax.Array, clean: jax.Array,
+                        prev) -> jax.Array:
+    """The ``quarantine:auto`` per-round threshold basis: the largest
+    clean (sub-threshold) z, RISE-capped at the larger of
+    :data:`Z_AUTO_TRIM_GAP` times the second-largest clean z and the
+    carried estimate ``prev`` (traced, shape-stable).
+
+    Rationale (the bounded-drift contract, ``tests/test_reputation.py``
+    attack-trajectory test): a patient attacker parking its score just
+    under the current threshold is the clean MAX every round, so an
+    untrimmed max basis lets it ratchet the running estimate — and so
+    the threshold — all the way to ``Z_AUTO_MAX``, widening its own
+    headroom each round. The cap is one-sided by design: the basis may
+    follow the raw clean max DOWN freely (tightening on honest quiet
+    cohorts exactly as before), but may not pull the estimate UP past
+    ``gap x runner-up`` — with one attacker the runner-up is honest,
+    so a parked attacker cannot raise the estimate at all once it is
+    the only separated score, and the threshold stays bounded by
+    ``Z_AUTO_MARGIN * max(prev, Z_AUTO_TRIM_GAP x honest max)``
+    instead of ratcheting. An honest cohort is untouched: any clean
+    max at or below the carried estimate (or within the gap of its
+    runner-up) passes through raw, so honest spread keeps exactly the
+    pre-trim threshold dynamics.
+
+    With fewer than two clean entries the raw max is returned (a
+    single score has no runner-up to trim against); with zero clean
+    entries the result is ``-inf`` and callers gate on the count,
+    exactly like :func:`_masked_vector_quantile`.
+    """
+    top = _masked_vector_quantile(z, clean, Z_AUTO_Q)
+    J = z.shape[0]
+    n = jnp.sum(clean).astype(jnp.int32)
+    # second-largest clean score: ascending sort with absent entries at
+    # -inf puts the clean set on top; index J-2 of the clean block
+    s = jnp.sort(jnp.where(clean > 0, z, -jnp.inf))
+    second = s[jnp.clip(J - 2, 0, J - 1)]
+    cap = jnp.maximum(Z_AUTO_TRIM_GAP * second, jnp.float32(prev))
+    return jnp.where(n >= 2, jnp.minimum(top, cap), top)
 
 
 def zscore_quarantine(params, stacked, present: jax.Array, z_max,

@@ -9,6 +9,7 @@ from .mesh import (
     replicated,
     shard_client_keys,
     shard_setup,
+    validate_cohort_alignment,
 )
 
 __all__ = [
@@ -22,4 +23,5 @@ __all__ = [
     "replicated",
     "shard_client_keys",
     "shard_setup",
+    "validate_cohort_alignment",
 ]

@@ -175,3 +175,24 @@ def shard_setup(setup, mesh: Mesh):
 def shard_client_keys(keys: jax.Array, mesh: Mesh) -> jax.Array:
     """Shard a (J, ...) per-client key array over the client axis."""
     return jax.device_put(keys, client_spec(mesh, keys.ndim))
+
+
+def validate_cohort_alignment(n_shards: int, n_devices: int) -> None:
+    """Check that an in-graph cohort shard count composes with a mesh.
+
+    The two-tier reduction (``fedcore.hierarchy``) assigns CONTIGUOUS
+    shard ids, and ``shard_setup`` places the client axis in contiguous
+    per-device blocks — so each shard's ``segment_sum`` partial is
+    device-LOCAL exactly when every device holds a whole number of
+    shards, i.e. the device count divides the shard count. A
+    misaligned count would silently make every partial sum a
+    cross-device reduction (the communication pattern the hierarchy
+    exists to avoid), so it is refused loudly instead.
+    """
+    if n_devices > 1 and n_shards % n_devices != 0:
+        raise ValueError(
+            f"cohort_shards={n_shards} does not align with the "
+            f"{n_devices}-device client mesh: contiguous shard "
+            "boundaries must not straddle devices (each device must "
+            "hold a whole number of shards) — use a multiple of "
+            f"{n_devices}")

@@ -90,7 +90,9 @@ class RolloutController:
     def __init__(self, service, registry, mode: str = "shadow",
                  fraction: float = 0.1, min_requests: int = 50,
                  error_budget: int = 0, min_agreement: float | None = None,
-                 parity_data=None, parity_tol: float = 1e-4):
+                 parity_data=None, parity_tol: float = 1e-4,
+                 ramp_every: int | None = None, ramp_factor: float = 2.0,
+                 max_fraction: float = 1.0):
         """``parity_data``: ``(X, y)`` — the SAME raw test rows and
         labels training evaluated on when it recorded the candidate's
         ``metadata['eval_acc']`` (for ``exp.py --publish_every``
@@ -114,6 +116,21 @@ class RolloutController:
         there are no paired live outputs to compare — configuring the
         floor there would silently never be enforced, so it is
         refused instead.
+
+        ``ramp_every``: the FRACTIONAL RAMP (PR 6 follow-on) — grow
+        the candidate split on observed error budget instead of
+        serving a fixed per-stage fraction: every ``ramp_every``
+        candidate dispatches, a window that stayed error-FREE
+        multiplies ``fraction`` by ``ramp_factor`` (capped at
+        ``max_fraction``); a window with any error holds the current
+        fraction (the budget check still rolls the whole canary back
+        when exceeded — the ramp only decides how fast exposure
+        GROWS, never whether the candidate survives). The hash split
+        is monotone in the fraction (``assigned_to_candidate``), so
+        every already-assigned request id stays on the candidate
+        through each growth step — no flapping. ``None`` (default)
+        keeps the fixed-fraction behavior. ``fraction`` is then the
+        ramp's STARTING exposure; each ``stage()`` restarts from it.
         """
         if mode not in self.MODES:
             raise ValueError(f"mode must be one of {self.MODES}, "
@@ -129,11 +146,27 @@ class RolloutController:
             raise ValueError(f"fraction must be in (0, 1], got {fraction}")
         if min_requests < 0 or error_budget < 0:
             raise ValueError("min_requests/error_budget must be >= 0")
+        if ramp_every is not None and ramp_every < 1:
+            raise ValueError(f"ramp_every must be >= 1 (dispatches per "
+                             f"ramp window), got {ramp_every}")
+        if ramp_factor <= 1.0:
+            raise ValueError(f"ramp_factor must be > 1 (the ramp grows "
+                             f"exposure), got {ramp_factor}")
+        if not fraction <= max_fraction <= 1.0:
+            raise ValueError(
+                f"need fraction <= max_fraction <= 1, got "
+                f"fraction={fraction} max_fraction={max_fraction}")
         self.service = service
         self.engine = service.engine
         self.registry = registry
         self.mode = mode
         self.fraction = float(fraction)
+        self.base_fraction = float(fraction)  # each stage() restarts here
+        self.ramp_every = None if ramp_every is None else int(ramp_every)
+        self.ramp_factor = float(ramp_factor)
+        self.max_fraction = float(max_fraction)
+        self._ramp_served = 0   # candidate dispatches this ramp window
+        self._ramp_errors = 0   # candidate errors this ramp window
         self.min_requests = int(min_requests)
         self.error_budget = int(error_budget)
         self.min_agreement = (None if min_agreement is None
@@ -200,7 +233,7 @@ class RolloutController:
         caller never saw them), ``agreement`` as ``(matching_rows,
         total_rows)`` from a shadow/A-B comparison. Drives the
         promote/rollback decision inline."""
-        promote = rollback_reason = None
+        promote = rollback_reason = ramped_to = None
         with self._lock:
             if self._candidate != version:
                 return  # a stale report from before a rollback
@@ -209,6 +242,30 @@ class RolloutController:
             if agreement is not None:
                 self._agree_hits += int(agreement[0])
                 self._agree_rows += int(agreement[1])
+            if self.ramp_every is not None:
+                # fractional ramp: an error-free window grows the
+                # split; a window with any error holds it (the budget
+                # check below still decides survival). Mutated under
+                # the lock split() reads the fraction through, so the
+                # worker's next batch sees the grown split atomically.
+                # Window progress counts DISPATCHES (successes and
+                # errors both) — an erroring candidate must not take
+                # longer to close its window than a healthy one. A
+                # batched report can close SEVERAL windows: each is
+                # consumed with its residual carried (a reset-to-zero
+                # would silently stretch the configured schedule), and
+                # the batch's errors land on the earliest open window.
+                self._ramp_served += int(served) + int(errors)
+                self._ramp_errors += int(errors)
+                while self._ramp_served >= self.ramp_every:
+                    self._ramp_served -= self.ramp_every
+                    if (self._ramp_errors == 0
+                            and self.fraction < self.max_fraction):
+                        self.fraction = min(
+                            self.max_fraction,
+                            self.fraction * self.ramp_factor)
+                        ramped_to = self.fraction
+                    self._ramp_errors = 0
             if self._errors > self.error_budget:
                 rollback_reason = (
                     f"error budget exceeded: {self._errors} candidate "
@@ -222,6 +279,8 @@ class RolloutController:
                         f"{self.min_agreement} floor")
                 else:
                     promote = True
+        if ramped_to is not None and not rollback_reason:
+            self._event("ramped", version=version, fraction=ramped_to)
         if rollback_reason:
             # expected= pins the action to the candidate the decision
             # was ABOUT: if another thread rolled back and staged a
@@ -329,6 +388,11 @@ class RolloutController:
                 self._candidate = version
                 self._served = self._errors = 0
                 self._agree_hits = self._agree_rows = 0
+                # the ramp restarts from the configured base exposure
+                # for every new candidate (a prior rollout's grown
+                # fraction is ITS earned trust, not this one's)
+                self.fraction = self.base_fraction
+                self._ramp_served = self._ramp_errors = 0
         finally:
             with self._lock:
                 self._staging = False
@@ -447,6 +511,8 @@ class RolloutController:
                 "candidate": self._candidate,
                 "mode": self.mode,
                 "fraction": self.fraction,
+                "ramp_every": self.ramp_every,
+                "max_fraction": self.max_fraction,
                 "served": self._served,
                 "errors": self._errors,
                 "agreement": self._agreement_locked(),

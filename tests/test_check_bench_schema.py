@@ -210,3 +210,64 @@ def test_rejects_non_json_and_unknown_family(tmp_path):
 def test_expect_some_fails_on_empty_root(tmp_path):
     assert cbs.main(["--root", str(tmp_path), "--expect-some"]) == 1
     assert cbs.main(["--root", str(tmp_path)]) == 0
+
+
+def _good_scale():
+    return {
+        "schema": "SCALE.v1",
+        "metric": "updates_per_sec",
+        "platform": "cpu",
+        "records": [{"config": "cohort_stream", "wall_s": 4.8}],
+        "cohort": {
+            "clients": 1_000_000, "shards": 256, "shard_clients": 3907,
+            "rounds": 1, "streamed": True, "updates_per_sec": 2e5,
+            "wall_s": 4.8, "recompiles_after_warmup": 0,
+        },
+    }
+
+
+def test_scale_v1_validates_and_requires_cohort_section(tmp_path):
+    assert cbs.validate_file(
+        _write(tmp_path, "SCALE_r09.json", _good_scale())) == []
+    art = _good_scale()
+    del art["cohort"]
+    errs = cbs.validate_file(_write(tmp_path, "SCALE_r09.json", art))
+    assert any("cohort" in e for e in errs)
+    # an unparseable version must not silently skip the cohort rules
+    art = _good_scale()
+    art["schema"] = "SCALE.v1-rc1"
+    errs = cbs.validate_file(_write(tmp_path, "SCALE_r09.json", art))
+    assert any("unparseable schema version" in e for e in errs)
+
+
+def test_scale_rejects_cohort_drift(tmp_path):
+    # a recompile during the streamed sweep must never land green
+    art = _good_scale()
+    art["cohort"]["recompiles_after_warmup"] = 2
+    errs = cbs.validate_file(_write(tmp_path, "SCALE_r09.json", art))
+    assert any("recompiles_after_warmup" in e for e in errs)
+    # a one-shard "cohort" never exercised the two-tier fold
+    art = _good_scale()
+    art["cohort"]["shards"] = 1
+    errs = cbs.validate_file(_write(tmp_path, "SCALE_r09.json", art))
+    assert any("shards" in e for e in errs)
+    # an unstreamed leg is not the thing this section certifies
+    art = _good_scale()
+    art["cohort"]["streamed"] = False
+    errs = cbs.validate_file(_write(tmp_path, "SCALE_r09.json", art))
+    assert any("streamed" in e for e in errs)
+    # throughput/wall time must be positive numbers
+    art = _good_scale()
+    art["cohort"]["updates_per_sec"] = 0
+    errs = cbs.validate_file(_write(tmp_path, "SCALE_r09.json", art))
+    assert any("updates_per_sec" in e for e in errs)
+    # the records list itself is part of the contract
+    art = _good_scale()
+    art["records"] = []
+    errs = cbs.validate_file(_write(tmp_path, "SCALE_r09.json", art))
+    assert any("records" in e for e in errs)
+    # family check: a non-SCALE schema in a SCALE_ file
+    art = _good_scale()
+    art["schema"] = "BENCH_SERVE.v3"
+    errs = cbs.validate_file(_write(tmp_path, "SCALE_r09.json", art))
+    assert any("SCALE. family" in e for e in errs)

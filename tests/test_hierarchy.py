@@ -1,0 +1,369 @@
+"""The million-client cohort plane (ISSUE 8): sharded client axis,
+two-tier hierarchical aggregation, streamed shards.
+
+Load-bearing contracts:
+
+- **Sharded == unsharded**: for FedAvg/FedProx/FedNova/FedAMW, the
+  in-graph two-tier reduction reproduces the flat path's aggregates to
+  float re-association tolerance, and every quarantine/gating DECISION
+  is bit-identical (the per-client evidence never changes — only the
+  final weighted sum is re-associated).
+- **Zero recompiles across shard counts AND fault plans**: the shard
+  count is a traced scalar and the plan rows are scanned inputs, so
+  one compiled program covers the whole ``--cohort_shards`` sweep
+  (the fault plane's zero-recompile contract extends to the
+  hierarchy).
+- **Reputation carry round-trip**: the ``O(J)`` reputation vector
+  rides the sharded carry unchanged — prefix + checkpoint + resume
+  under ``cohort_shards`` reproduces the uninterrupted sharded run.
+- **Streamed shards**: the host-loop tier (one compiled shard-tier
+  program, double-buffered host->device shards) reproduces the flat
+  clean run within tolerance, keeps the defended path (shard-local
+  evidence), and is bounded by host RAM, not HBM — the 1M-client leg
+  lives in ``scale_bench.py`` (``cohort`` section of SCALE_r01.json).
+"""
+
+import numpy as np
+import pytest
+
+from fedamw_tpu.algorithms import (FedAMW, FedAvg, FedNova, FedProx,
+                                   prepare_setup)
+from fedamw_tpu.algorithms import core
+from fedamw_tpu.data import CohortShardStream, load_dataset
+from fedamw_tpu.fedcore.hierarchy import (MAX_COHORT_SHARDS,
+                                          resolve_cohort_shards,
+                                          shard_histogram, shard_ids,
+                                          two_tier_weighted_average)
+from fedamw_tpu.fedcore.aggregate import (segment_weighted_sums,
+                                          weighted_average)
+from fedamw_tpu.parallel import validate_cohort_alignment
+
+pytestmark = pytest.mark.faults
+
+KW = dict(lr=0.5, epoch=1, batch_size=32, round=3, seed=0,
+          lr_mode="constant")
+FAULTS = "drop=0.2,corrupt=0.1:scale:25,seed=3"
+
+
+@pytest.fixture(scope="module")
+def setup8():
+    ds = load_dataset("digits", num_partitions=8, alpha=0.5)
+    return prepare_setup(ds, kernel_type="linear", seed=3,
+                         rng=np.random.RandomState(3))
+
+
+# -- shard assignment / reductions (unit tier) ------------------------
+
+def test_shard_ids_contiguous_and_balanced():
+    ids = np.asarray(shard_ids(8, 4))
+    np.testing.assert_array_equal(ids, [0, 0, 1, 1, 2, 2, 3, 3])
+    # non-divisible cohorts stay contiguous and off-by-at-most-one
+    ids = np.asarray(shard_ids(10, 3))
+    assert (np.diff(ids) >= 0).all() and ids[0] == 0 and ids[-1] == 2
+    counts = np.bincount(ids, minlength=3)
+    assert counts.max() - counts.min() <= 1
+    # one shard = the flat assignment
+    assert np.asarray(shard_ids(5, 1)).sum() == 0
+
+
+def test_resolve_cohort_shards_validation():
+    assert resolve_cohort_shards(0, 8) == 0
+    assert resolve_cohort_shards(4, 8) == 4
+    with pytest.raises(ValueError, match=">= 0"):
+        resolve_cohort_shards(-1, 8)
+    with pytest.raises(ValueError, match="exceeds the cohort"):
+        resolve_cohort_shards(9, 8)
+    with pytest.raises(ValueError, match="MAX_COHORT_SHARDS"):
+        resolve_cohort_shards(MAX_COHORT_SHARDS + 1,
+                              10 * MAX_COHORT_SHARDS)
+    # streamed sharding has no static partial-buffer cap
+    assert resolve_cohort_shards(
+        MAX_COHORT_SHARDS + 1, 10 * MAX_COHORT_SHARDS,
+        streamed=True) == MAX_COHORT_SHARDS + 1
+
+
+def test_two_tier_matches_flat_weighted_average():
+    rng = np.random.RandomState(0)
+    J = 12
+    stacked = {"w": rng.randn(J, 5, 3).astype(np.float32),
+               "b": rng.randn(J, 3).astype(np.float32)}
+    w = rng.rand(J).astype(np.float32)
+    flat = weighted_average(stacked, w)
+    for s in (1, 3, 4, 12):
+        ids = shard_ids(J, s)
+        two = two_tier_weighted_average(stacked, w, ids)
+        for k in stacked:
+            np.testing.assert_allclose(np.asarray(two[k]),
+                                       np.asarray(flat[k]), rtol=2e-6,
+                                       atol=1e-6)
+
+
+def test_segment_weighted_sums_partials_fold_exactly():
+    rng = np.random.RandomState(1)
+    J = 8
+    stacked = {"w": rng.randn(J, 4).astype(np.float32)}
+    w = rng.rand(J).astype(np.float32)
+    ids = shard_ids(J, 4)
+    parts = segment_weighted_sums(stacked, w, ids, MAX_COHORT_SHARDS)
+    assert parts["w"].shape == (MAX_COHORT_SHARDS, 4)
+    # each partial is its own shard's weighted sum; rows past the
+    # shard count are exactly zero
+    for s in range(4):
+        sl = slice(2 * s, 2 * s + 2)
+        np.testing.assert_allclose(
+            np.asarray(parts["w"][s]),
+            (w[sl, None] * stacked["w"][sl]).sum(0), rtol=1e-6)
+    assert not np.asarray(parts["w"][4:]).any()
+
+
+def test_shard_histogram_counts_per_shard():
+    ids = shard_ids(8, 4)
+    h = np.asarray(shard_histogram(np.ones(8, np.float32), ids))
+    np.testing.assert_array_equal(h[:4], [2, 2, 2, 2])
+    assert h[4:].sum() == 0
+
+
+def test_validate_cohort_alignment():
+    validate_cohort_alignment(8, 4)   # whole shards per device
+    validate_cohort_alignment(7, 1)   # single device: anything goes
+    with pytest.raises(ValueError, match="align"):
+        validate_cohort_alignment(6, 4)
+
+
+# -- sharded == unsharded (the equivalence sweep) ---------------------
+
+@pytest.mark.parametrize("algo,extra", [
+    (FedAvg, {}),
+    (FedProx, dict(prox=True, mu=0.1)),
+    (FedNova, {}),
+    (FedAMW, dict(lambda_reg=1e-4, lr_p=1e-4)),
+])
+def test_sharded_matches_unsharded_clean(setup8, algo, extra):
+    flat = algo(setup8, **KW, **extra)
+    sh = algo(setup8, cohort_shards=4, **KW, **extra)
+    np.testing.assert_allclose(sh["test_loss"], flat["test_loss"],
+                               rtol=5e-5, atol=1e-6)
+    np.testing.assert_allclose(sh["train_loss"], flat["train_loss"],
+                               rtol=5e-5, atol=1e-6)
+    h = sh["hierarchy"]
+    assert h["cohort_shards"] == 4
+    assert h["shard_present"].shape == (KW["round"], 4)
+    assert (h["shard_present"].sum(axis=1)
+            == setup8.num_clients).all()
+
+
+@pytest.mark.parametrize("algo,extra", [
+    (FedAvg, {}),
+    (FedNova, {}),
+    (FedAMW, dict(lambda_reg=1e-4, lr_p=1e-4)),
+])
+def test_sharded_decisions_bitwise_identical_under_faults(setup8, algo,
+                                                          extra):
+    """Same cohort, same faults: the sharded run's quarantine and
+    gating DECISIONS equal the flat run's exactly — evidence is
+    per-client (shard-local by construction) and only the final
+    reduction is re-associated."""
+    kw = dict(KW, faults=FAULTS, robust_agg="quarantine:5")
+    flat = algo(setup8, **kw, **extra)
+    sh = algo(setup8, cohort_shards=4, **kw, **extra)
+    np.testing.assert_array_equal(
+        sh["defense"]["z_quarantined"], flat["defense"]["z_quarantined"])
+    np.testing.assert_array_equal(
+        sh["fault_counts"]["quarantined"],
+        flat["fault_counts"]["quarantined"])
+    np.testing.assert_allclose(sh["test_loss"], flat["test_loss"],
+                               rtol=5e-5, atol=1e-6)
+
+
+def test_sharded_reputation_gating_identical(setup8):
+    """The stateful plane: the carried reputation trajectory and its
+    hard-gate verdicts are bit-identical under sharding (the O(J)
+    carry rides the sharded scan unchanged)."""
+    kw = dict(KW, faults="corrupt=0.25:sign,seed=1",
+              robust_agg="rep:0.5:0.2")
+    flat = FedAvg(setup8, **kw)
+    sh = FedAvg(setup8, cohort_shards=2, **kw)
+    np.testing.assert_array_equal(sh["defense"]["rep_gated"],
+                                  flat["defense"]["rep_gated"])
+    np.testing.assert_allclose(sh["defense"]["reputation"],
+                               flat["defense"]["reputation"],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_order_statistic_aggregators_still_run_sharded(setup8):
+    """median/krum fold globally by definition — the hierarchy keeps
+    their flat reduction (documented), and the run stays equal to the
+    flat one (selection masks identical)."""
+    kw = dict(KW, faults="corrupt=0.2:sign,seed=2", robust_agg="mkrum:5")
+    flat = FedAvg(setup8, **kw)
+    sh = FedAvg(setup8, cohort_shards=4, **kw)
+    np.testing.assert_array_equal(sh["defense"]["krum_selected"],
+                                  flat["defense"]["krum_selected"])
+    np.testing.assert_allclose(sh["test_loss"], flat["test_loss"],
+                               rtol=5e-5, atol=1e-6)
+
+
+# -- zero recompiles across fault plans and shard counts --------------
+
+def test_shard_count_change_adds_no_recompile(setup8):
+    """The shard count is DATA (a traced scalar), the plan rows are
+    scanned inputs: one trainer, one compiled program across the whole
+    (fault plan x shard count) sweep."""
+    FedAvg(setup8, cohort_shards=2, faults=FAULTS,
+           robust_agg="quarantine:5", **KW)
+    fn = core._LAST_TRAIN_FN
+    size0 = fn._cache_size() if hasattr(fn, "_cache_size") else None
+    for shards, faults in ((4, FAULTS), (8, "drop=0.1,seed=9"),
+                           (1, "corrupt=0.3:sign,seed=4")):
+        FedAvg(setup8, cohort_shards=shards, faults=faults,
+               robust_agg="quarantine:5", **KW)
+        assert core._LAST_TRAIN_FN is fn  # same memoized trainer
+        if size0 is not None:
+            assert fn._cache_size() == size0  # same compiled program
+
+
+def test_hierarchy_off_keeps_the_flat_trainer(setup8):
+    """cohort_shards=0 is the exact flat graph: it shares the
+    memoized trainer (and compiled program) with a run that never
+    heard of the hierarchy — the flag is program structure only when
+    ON."""
+    FedAvg(setup8, **KW)
+    fn = core._LAST_TRAIN_FN
+    FedAvg(setup8, cohort_shards=0, **KW)
+    assert core._LAST_TRAIN_FN is fn
+
+
+# -- reputation carry round-trip across shards ------------------------
+
+def test_sharded_rep_resume_roundtrip(setup8):
+    """Prefix + checkpoint + resume under cohort_shards == the
+    uninterrupted sharded run, reputation carry included (the O(J)
+    vector resumes through the sharded trainer unchanged)."""
+    kw = dict(lr=0.5, epoch=1, batch_size=32, seed=0,
+              lr_mode="reference", cohort_shards=4,
+              faults="corrupt=0.25:sign,seed=1",
+              robust_agg="rep:0.5:0.2")
+    full = FedAvg(setup8, round=4, return_state=True, **kw)
+    prefix = FedAvg(setup8, round=4, stop_round=2, return_state=True,
+                    **kw)
+    resumed = FedAvg(setup8, round=4, start_round=2,
+                     resume_from={"params": prefix["params"],
+                                  "reputation": prefix["reputation"]},
+                     return_state=True, **kw)
+    np.testing.assert_array_equal(resumed["test_acc"],
+                                  np.asarray(full["test_acc"])[2:])
+    np.testing.assert_array_equal(np.asarray(resumed["reputation"]),
+                                  np.asarray(full["reputation"]))
+
+
+# -- streamed shards --------------------------------------------------
+
+def test_streamed_matches_flat_clean(setup8):
+    flat = FedAvg(setup8, **KW)
+    st = FedAvg(setup8, cohort_shards=4, stream_cohort=True, **KW)
+    np.testing.assert_allclose(st["test_acc"], flat["test_acc"],
+                               atol=1e-4)
+    np.testing.assert_allclose(st["train_loss"], flat["train_loss"],
+                               rtol=5e-5, atol=1e-6)
+    assert st["streamed"] == {
+        "cohort_shards": 4, "shard_clients": 2,
+        "present": pytest.approx([8.0] * KW["round"]),
+    }
+
+
+def test_streamed_nova_matches_flat(setup8):
+    flat = FedNova(setup8, **KW)
+    st = FedNova(setup8, cohort_shards=2, stream_cohort=True, **KW)
+    np.testing.assert_allclose(st["test_acc"], flat["test_acc"],
+                               atol=1e-4)
+
+
+def test_streamed_defended_round_quarantines(setup8):
+    """The streamed tier keeps the defended path: shard-local
+    non-finite + z quarantine evidence folds into the global counters
+    (a 25x attacker is an upper outlier inside its own shard too)."""
+    st = FedAvg(setup8, cohort_shards=2, stream_cohort=True,
+                faults=FAULTS, robust_agg="quarantine:5", **KW)
+    flat = FedAvg(setup8, faults=FAULTS, robust_agg="quarantine:5",
+                  **KW)
+    # role counts are plan facts — identical by construction
+    np.testing.assert_array_equal(st["fault_counts"]["dropped"],
+                                  flat["fault_counts"]["dropped"])
+    np.testing.assert_array_equal(st["fault_counts"]["corrupted"],
+                                  flat["fault_counts"]["corrupted"])
+    # the runtime verdicts catch the attackers (stats are shard-local,
+    # so exact equality with the flat run is not contractual)
+    assert (st["fault_counts"]["quarantined"]
+            >= flat["fault_counts"]["corrupted"]).all()
+    assert np.isfinite(st["test_loss"]).all()
+
+
+def test_streamed_zero_recompile_across_rounds_and_plans(setup8):
+    """ONE shard-tier program serves every shard of every round of
+    every same-config run — fault plans and round counts are data.
+    Changing the shard COUNT changes the per-shard static shape (the
+    streamed mode's one shape axis), costing exactly one more program
+    — never one per shard or per round."""
+    FedAvg(setup8, cohort_shards=2, stream_cohort=True, faults=FAULTS,
+           robust_agg="quarantine:5", **KW)
+    tier = core._LAST_SHARD_TIER
+    size0 = tier._cache_size() if hasattr(tier, "_cache_size") else None
+    FedAvg(setup8, cohort_shards=2, stream_cohort=True,
+           faults="drop=0.3,seed=11", robust_agg="quarantine:5",
+           **dict(KW, round=5))
+    assert core._LAST_SHARD_TIER is tier  # same memoized tier
+    if size0 is not None:
+        assert tier._cache_size() == size0  # plans/rounds are data
+    FedAvg(setup8, cohort_shards=4, stream_cohort=True, faults=FAULTS,
+           robust_agg="quarantine:5", **KW)
+    assert core._LAST_SHARD_TIER is tier
+    if size0 is not None:
+        # a new shard SHAPE is one new program, not one per shard/round
+        assert tier._cache_size() == size0 + 1
+
+
+def test_streamed_surface_is_guarded(setup8):
+    with pytest.raises(ValueError, match="learned"):
+        FedAMW(setup8, cohort_shards=2, stream_cohort=True, **KW)
+    with pytest.raises(ValueError, match="cohort_shards"):
+        FedAvg(setup8, stream_cohort=True, **KW)
+    with pytest.raises(ValueError, match="global statistics"):
+        FedAvg(setup8, cohort_shards=2, stream_cohort=True,
+               robust_agg="rep:0.9:0.2", **KW)
+    with pytest.raises(ValueError, match="sequential"):
+        FedAvg(setup8, cohort_shards=2, stream_cohort=True,
+               sequential=True, **KW)
+
+
+def test_cohort_shard_stream_double_buffers_all_shards():
+    J, n_max = 8, 3
+    idx = np.arange(J * n_max, dtype=np.int32).reshape(J, n_max)
+    mask = np.ones((J, n_max), np.float32)
+    sizes = np.full(J, n_max, np.int32)
+    p = np.full(J, 1.0 / J, np.float32)
+    stream = CohortShardStream(4, idx=idx, mask=mask, sizes=sizes,
+                               p_fixed=p)
+    keys = np.arange(J * 2, dtype=np.uint32).reshape(J, 2)
+    rows = np.arange(J, dtype=np.float32)
+    fault_rows = (rows, rows + 1, rows + 2, rows + 3, rows + 4)
+    seen = []
+    for s, shard in stream.round_shards(keys, fault_rows=fault_rows):
+        assert shard["idx"].shape == (2, n_max)
+        assert shard["keys"].shape == (2, 2)
+        assert len(shard["fault_rows"]) == 5
+        np.testing.assert_array_equal(np.asarray(shard["idx"]),
+                                      idx[2 * s:2 * s + 2])
+        np.testing.assert_array_equal(
+            np.asarray(shard["fault_rows"][0]), rows[2 * s:2 * s + 2])
+        seen.append(s)
+    assert seen == [0, 1, 2, 3]
+
+
+def test_cohort_shard_stream_rejects_ragged_split():
+    idx = np.zeros((10, 2), np.int32)
+    with pytest.raises(ValueError, match="client_multiple"):
+        CohortShardStream(4, idx=idx, mask=np.zeros((10, 2)),
+                          sizes=np.zeros(10), p_fixed=np.zeros(10))
+    with pytest.raises(ValueError, match=">= 1"):
+        CohortShardStream(0, idx=idx, mask=np.zeros((10, 2)),
+                          sizes=np.zeros(10), p_fixed=np.zeros(10))

@@ -536,3 +536,126 @@ def test_rep_soft_only_mode_downweights_without_gating(setup_het):
                  **KW)
     assert not np.array_equal(np.asarray(res["params"]["w"]),
                               np.asarray(und["params"]["w"]))
+
+
+# -- quarantine:auto bounded threshold drift (PR 8 satellite) ----------
+
+def _threshold_trajectory(basis_fn, honest_z, rounds, park=0.98):
+    """Simulate the carried-zq recursion (algorithms.core.guard_faults'
+    exact EWMA/clip arithmetic, host-side) under a PATIENT attacker
+    that parks its z at ``park`` x the CURRENT threshold every round —
+    always clean, always the clean max. ``basis_fn(z, clean, zq)`` is
+    the per-round threshold basis under test."""
+    from fedamw_tpu.fedcore.robust import Z_AUTO_BETA, Z_AUTO_INIT, \
+        Z_AUTO_MARGIN
+    zq, thresholds = Z_AUTO_INIT, []
+    for _ in range(rounds):
+        thr = float(np.clip(Z_AUTO_MARGIN * zq, Z_AUTO_MIN, Z_AUTO_MAX))
+        thresholds.append(thr)
+        z = np.append(honest_z, park * thr).astype(np.float32)
+        clean = (z <= thr).astype(np.float32)
+        q = float(basis_fn(z, clean, zq))
+        zq = (1.0 - Z_AUTO_BETA) * zq + Z_AUTO_BETA * q
+    return np.asarray(thresholds)
+
+
+def test_patient_attacker_cannot_ratchet_trimmed_threshold():
+    """The attack trajectory the ROADMAP carried follow-on names: a
+    just-under-threshold attacker is the clean MAX by construction, so
+    the OLD untrimmed max basis lets it drag the running estimate —
+    and the threshold — all the way to Z_AUTO_MAX, widening its own
+    headroom every round. Under the rise-capped basis its UPWARD pull
+    is bounded by the gap over the honest runner-up, so the threshold
+    never exceeds its start and settles no higher than
+    Z_AUTO_MARGIN * Z_AUTO_TRIM_GAP x the honest max."""
+    from fedamw_tpu.fedcore.robust import (Z_AUTO_MARGIN,
+                                           Z_AUTO_TRIM_GAP,
+                                           _masked_vector_quantile,
+                                           trimmed_clean_basis)
+    honest = np.array([0.5, 0.9, 1.3, 1.8, 2.2], np.float32)
+    untrimmed = _threshold_trajectory(
+        lambda z, c, _zq: _masked_vector_quantile(
+            np.asarray(z), np.asarray(c), 1.0), honest, rounds=200)
+    trimmed = _threshold_trajectory(trimmed_clean_basis, honest,
+                                    rounds=200)
+    # the drift: the untrimmed threshold ratchets to the hard cap
+    assert untrimmed[-1] == Z_AUTO_MAX
+    # the bound: the attacker can never RAISE the capped threshold —
+    # it holds at its starting operating point instead of ratcheting,
+    # and the attacker never earns one point of extra headroom
+    assert trimmed.max() <= trimmed[0] + 1e-4
+    assert np.all(np.diff(trimmed[50:]) <= 1e-6)  # no late ratchet
+    # recovery: once the attacker leaves, the honest folds tighten the
+    # threshold toward the contract's honest ceiling
+    from fedamw_tpu.fedcore.robust import Z_AUTO_BETA
+    zq = trimmed[-1] / Z_AUTO_MARGIN
+    for _ in range(100):
+        q = float(trimmed_clean_basis(
+            honest, np.ones_like(honest), zq))
+        zq = (1.0 - Z_AUTO_BETA) * zq + Z_AUTO_BETA * q
+    settled = np.clip(Z_AUTO_MARGIN * zq, Z_AUTO_MIN, Z_AUTO_MAX)
+    bound = Z_AUTO_MARGIN * Z_AUTO_TRIM_GAP * float(honest.max())
+    assert settled <= bound + 1e-3
+
+
+def test_trimmed_basis_honest_cohort_untouched():
+    """A clean max at or below the carried estimate (or within the
+    gap of its runner-up) passes through RAW — honest cohorts keep the
+    pre-trim threshold dynamics; the cap bites only on a separated
+    top score trying to pull the estimate UP."""
+    from fedamw_tpu.fedcore.robust import (Z_AUTO_TRIM_GAP,
+                                           trimmed_clean_basis)
+    z = np.array([0.5, 1.6, 2.0, 2.4], np.float32)
+    clean = np.ones(4, np.float32)
+    assert float(trimmed_clean_basis(z, clean, 10 / 3)) == \
+        pytest.approx(2.4)
+    # a separated top trying to RAISE the estimate is capped at
+    # max(gap x runner-up, the carried estimate)
+    z_sep = np.array([0.5, 1.0, 1.2, 4.0], np.float32)
+    assert float(trimmed_clean_basis(z_sep, clean, 1.0)) == \
+        pytest.approx(Z_AUTO_TRIM_GAP * 1.2)
+    assert float(trimmed_clean_basis(z_sep, clean, 3.0)) == \
+        pytest.approx(3.0)  # never below the carried estimate
+    # ...but the basis follows the raw max DOWN freely (one-sided cap)
+    assert float(trimmed_clean_basis(z, clean, 3.0)) == \
+        pytest.approx(2.4)
+    # quarantined (non-clean) entries never enter the basis
+    mask = np.array([1, 1, 1, 0], np.float32)
+    assert float(trimmed_clean_basis(z_sep, mask, 1.0)) == \
+        pytest.approx(1.2)
+    # a single clean score has no runner-up to trim against
+    one = np.array([0, 0, 0, 1], np.float32)
+    assert float(trimmed_clean_basis(z_sep, one, 1.0)) == \
+        pytest.approx(4.0)
+
+
+def test_auto_threshold_trim_is_wired_into_the_round_scan(setup_het):
+    """Wiring pin (measured): the s=2 scale attacker's round-0 z
+    (~3.5) lands UNDER the initial Z=5 threshold — the one clean round
+    of a would-be patient attack. The rise-capped basis refuses to
+    fold that separated score upward (cap = max(gap x honest
+    runner-up ~1.5, the carried 10/3)), so the round-1 threshold
+    cannot exceed 5.0; the untrimmed max basis would fold the
+    attacker's 3.5 and RAISE it (1.5 * (0.9*10/3 + 0.1*3.5) ~ 5.03).
+    The trajectory staying at/below 5 with a near-threshold clean max
+    on record is therefore the cap demonstrably running inside the
+    jitted scan."""
+    R, J = 12, setup_het.num_clients
+    z = np.zeros((R, J), np.float32)
+    corrupt = z.copy()
+    corrupt[:, 2] = 1
+    scale = np.ones((R, J), np.float32)
+    scale[:, 2] = 2.0
+    plan = FaultPlan(z, z.copy(), corrupt, scale, z.copy(), z.copy())
+    res = FedAvg(setup_het, faults=plan, robust_agg="quarantine:auto",
+                 round=R, lr=0.5, epoch=1, seed=0, lr_mode="constant")
+    d = res["defense"]
+    # round 0: the attacker is clean (just under the hand-tuned start)
+    assert 3.0 < d["z_max"][0] < 5.0
+    assert d["z_threshold"][0] == pytest.approx(5.0)
+    # the near-threshold clean score never RAISES the threshold
+    # (untrimmed: round 1 lands at ~5.03 > 5)
+    assert d["z_threshold"][1] <= 5.0 + 1e-5
+    assert np.asarray(d["z_threshold"]).max() <= 5.0 + 1e-5
+    # and the honest folds still tighten it downward afterwards
+    assert d["z_threshold"][-1] < 4.0

@@ -664,3 +664,132 @@ def test_retry_re_resolves_live_version_across_a_swap():
     np.testing.assert_allclose(out, 2 * out_old, rtol=1e-5)
     assert svc.metrics.retries == 1
     assert svc.metrics.requests_retried == 1
+
+
+# -- fractional ramp (PR 8 satellite; PR 6 follow-on) ------------------
+
+def _staged_ramp_controller(svc, reg, **ramp_kw):
+    """A staged candidate under a ramping controller, with
+    min_requests high enough that observe() never promotes during the
+    ramp assertions (the ramp is about EXPOSURE, not survival)."""
+    cand = reg.publish(base_params(2.0), round_idx=3)
+    ctl = RolloutController(svc, reg, mode="ab", min_requests=10_000,
+                            error_budget=2, **ramp_kw)
+    assert ctl.stage(cand) is True
+    return ctl, cand
+
+
+def test_ramp_grows_fraction_on_error_free_windows():
+    """Each error-free ramp_every-dispatch window multiplies the split
+    by ramp_factor, capped at max_fraction — exposure is EARNED from
+    the observed error budget, not scheduled."""
+    engine = make_engine()
+    reg = ModelRegistry()
+    with ServingService(engine, max_wait_ms=0.5) as svc:
+        ctl, cand = _staged_ramp_controller(
+            svc, reg, fraction=0.1, ramp_every=10, ramp_factor=2.0,
+            max_fraction=0.8)
+        assert ctl.split() == (cand, 0.1, "ab")
+        ctl.observe(cand, served=10)
+        assert ctl.split()[1] == pytest.approx(0.2)
+        ctl.observe(cand, served=4)   # mid-window: no growth yet
+        assert ctl.split()[1] == pytest.approx(0.2)
+        ctl.observe(cand, served=6)   # window completes error-free
+        assert ctl.split()[1] == pytest.approx(0.4)
+        ctl.observe(cand, served=10)
+        assert ctl.split()[1] == pytest.approx(0.8)  # capped
+        ctl.observe(cand, served=10)
+        assert ctl.split()[1] == pytest.approx(0.8)  # stays capped
+        ramps = [e for e in ctl.events if e["event"] == "ramped"]
+        assert [e["fraction"] for e in ramps] == \
+            [pytest.approx(f) for f in (0.2, 0.4, 0.8)]
+        ctl.rollback("test done")
+
+
+def test_ramp_window_with_error_holds_fraction():
+    """A window that observed a candidate error (still within the
+    budget) holds the current exposure; the NEXT error-free window
+    grows it again. Exceeding the budget still rolls the canary back
+    from whatever fraction the ramp reached."""
+    engine = make_engine()
+    reg = ModelRegistry()
+    with ServingService(engine, max_wait_ms=0.5) as svc:
+        ctl, cand = _staged_ramp_controller(
+            svc, reg, fraction=0.25, ramp_every=8, ramp_factor=2.0)
+        ctl.observe(cand, served=7, errors=1)  # window closes dirty
+        assert ctl.split()[1] == pytest.approx(0.25)  # held, not grown
+        ctl.observe(cand, served=8)            # clean window
+        assert ctl.split()[1] == pytest.approx(0.5)
+        # budget exceeded (error_budget=2): full rollback, ramp or not
+        ctl.observe(cand, served=2, errors=2)
+        assert ctl.split() is None
+        assert ctl.events[-1]["event"] == "rollback"
+
+
+def test_ramp_restarts_at_base_fraction_for_each_candidate():
+    """A new stage() must re-earn exposure from the configured base —
+    the prior rollout's grown fraction was ITS trust, not the next
+    candidate's."""
+    engine = make_engine()
+    reg = ModelRegistry()
+    with ServingService(engine, max_wait_ms=0.5) as svc:
+        ctl, cand = _staged_ramp_controller(
+            svc, reg, fraction=0.1, ramp_every=5, ramp_factor=4.0)
+        ctl.observe(cand, served=5)
+        assert ctl.split()[1] == pytest.approx(0.4)
+        ctl.rollback("operator")
+        cand2 = reg.publish(base_params(3.0), round_idx=4)
+        assert ctl.stage(cand2) is True
+        assert ctl.split() == (cand2, pytest.approx(0.1), "ab")
+        ctl.rollback("test done")
+
+
+def test_ramp_growth_keeps_assigned_ids_assigned():
+    """The ramp composes with the deterministic hash split: growing
+    the fraction is monotone — every id on the candidate at the
+    smaller split is still on it at the larger one (no flapping
+    mid-ramp), which is the property that makes a ramped rollout's
+    per-id behavior reproducible."""
+    ids = [f"req-{i}" for i in range(400)]
+    fractions = [0.1, 0.2, 0.4, 0.8, 1.0]
+    assigned = [{i for i in ids if assigned_to_candidate(i, f)}
+                for f in fractions]
+    for smaller, larger in zip(assigned, assigned[1:]):
+        assert smaller <= larger
+    # and the ramp actually exposes more traffic at each step
+    assert all(len(a) < len(b) for a, b in zip(assigned, assigned[1:]))
+
+
+def test_ramp_constructor_validation():
+    engine = make_engine()
+    reg = ModelRegistry()
+    with ServingService(engine, max_wait_ms=0.5) as svc:
+        with pytest.raises(ValueError, match="ramp_every"):
+            RolloutController(svc, reg, ramp_every=0)
+        with pytest.raises(ValueError, match="ramp_factor"):
+            RolloutController(svc, reg, ramp_every=5, ramp_factor=1.0)
+        with pytest.raises(ValueError, match="max_fraction"):
+            RolloutController(svc, reg, fraction=0.5, ramp_every=5,
+                              max_fraction=0.25)
+        # the slot must be clean after refused constructions
+        ctl = RolloutController(svc, reg, ramp_every=5)
+        assert ctl.status()["ramp_every"] == 5
+        ctl.detach()
+
+
+def test_ramp_batched_report_closes_multiple_windows():
+    """A single batched observe() carries its residual across window
+    boundaries: served=25 at ramp_every=10 closes two windows (two
+    growth steps) and leaves 5 dispatches toward the third — a
+    reset-to-zero would silently stretch the configured schedule for
+    workers that report in large batches."""
+    engine = make_engine()
+    reg = ModelRegistry()
+    with ServingService(engine, max_wait_ms=0.5) as svc:
+        ctl, cand = _staged_ramp_controller(
+            svc, reg, fraction=0.1, ramp_every=10, ramp_factor=2.0)
+        ctl.observe(cand, served=25)
+        assert ctl.split()[1] == pytest.approx(0.4)  # two windows
+        ctl.observe(cand, served=5)                  # residual + 5
+        assert ctl.split()[1] == pytest.approx(0.8)
+        ctl.rollback("test done")

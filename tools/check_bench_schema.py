@@ -37,6 +37,15 @@ Three artifact families, three rule sets:
 - ``MULTICHIP_rNN.json`` — the dryrun wrapper: ``n_devices``/``rc``/
   ``ok``/``tail``, with ``ok`` true iff ``rc == 0`` (a disagreeing
   pair is exactly the silent-green failure this tool exists to catch).
+- ``SCALE_rNN.json`` — ``scale_bench.py``'s own artifact (the ISSUE 8
+  cohort plane): ``schema`` in the ``SCALE.`` family, a ``platform``
+  label, a non-empty ``records`` list, and — from schema v1 on — a
+  ``cohort`` section for the million-client streamed leg: client/
+  shard/round counts, positive throughput and wall time,
+  ``streamed == true``, and ``recompiles_after_warmup == 0`` (ONE
+  compiled shard-tier program covers every shard of every round —
+  the streamed zero-recompile pin, re-checked here so a hand-edited
+  artifact can never land green).
 
 Exit status: 0 when every matched artifact validates, 1 otherwise
 (problems listed one per line on stderr). No matches is an ERROR under
@@ -54,7 +63,7 @@ import sys
 
 #: Filename prefix -> validator. Order matters: BENCH_SERVE_ must be
 #: tested before the BENCH_ prefix it also matches.
-FAMILIES = ("BENCH_SERVE_", "BENCH_", "MULTICHIP_")
+FAMILIES = ("BENCH_SERVE_", "BENCH_", "MULTICHIP_", "SCALE_")
 
 
 def _tail_json_lines(tail: str) -> list[dict]:
@@ -264,10 +273,67 @@ def check_multichip(art: dict, name: str) -> list[str]:
     return errs
 
 
+def check_scale_artifact(art: dict, name: str) -> list[str]:
+    """scale_bench.py's own SCALE.vN artifact (the cohort plane)."""
+    errs = []
+    schema = str(art.get("schema", ""))
+    if not schema.startswith("SCALE."):
+        errs.append(f"schema must be in the SCALE. family, "
+                    f"got {art.get('schema')!r}")
+        return errs
+    if not isinstance(art.get("platform"), str) or not art["platform"]:
+        errs.append("missing top-level 'platform' label")
+    records = art.get("records")
+    if not isinstance(records, list) or not records:
+        errs.append("'records' must be a non-empty list of per-config "
+                    "records")
+    else:
+        for i, rec in enumerate(records):
+            if not isinstance(rec, dict) or "config" not in rec:
+                errs.append(f"records[{i}]: missing 'config' label")
+            elif not isinstance(rec.get("wall_s"), (int, float)) \
+                    or rec["wall_s"] <= 0:
+                errs.append(f"records[{i}] ({rec['config']}): "
+                            "missing positive 'wall_s'")
+    try:
+        version = int(schema.rsplit(".v", 1)[1])
+    except (IndexError, ValueError):
+        # 'SCALE.v1-rc1' etc. would otherwise skip the cohort rules
+        # entirely — the silent-green landing this gate exists to stop
+        return errs + [f"unparseable schema version {schema!r} "
+                       "(expected SCALE.vN)"]
+    if version < 1:
+        return errs
+    cohort = art.get("cohort")
+    if not isinstance(cohort, dict):
+        return errs + ["schema v1+ requires a 'cohort' section (the "
+                       "million-client streamed leg)"]
+    for key in ("clients", "shards", "shard_clients", "rounds"):
+        if not isinstance(cohort.get(key), int) or cohort[key] < 1:
+            errs.append(f"cohort: {key!r} must be a positive int")
+    if isinstance(cohort.get("shards"), int) and cohort["shards"] < 2:
+        errs.append("cohort: 'shards' must be >= 2 (a one-shard "
+                    "cohort never exercised the two-tier fold)")
+    for key in ("updates_per_sec", "wall_s"):
+        if not isinstance(cohort.get(key), (int, float)) \
+                or cohort[key] <= 0:
+            errs.append(f"cohort: missing positive numeric {key!r}")
+    if cohort.get("streamed") is not True:
+        errs.append("cohort: 'streamed' must be true (the leg exists "
+                    "to certify the host->device streamed tier)")
+    if cohort.get("recompiles_after_warmup") != 0:
+        errs.append("cohort: recompiles_after_warmup="
+                    f"{cohort.get('recompiles_after_warmup')!r} — one "
+                    "compiled shard-tier program must cover every "
+                    "shard of every round")
+    return errs
+
+
 CHECKERS = {
     "BENCH_SERVE_": check_serve_artifact,
     "BENCH_": check_bench_wrapper,
     "MULTICHIP_": check_multichip,
+    "SCALE_": check_scale_artifact,
 }
 
 
