@@ -21,6 +21,14 @@ drift. The two pieces:
   drivers wrap their timed legs in (``BENCH_PROFILE_DIR``, or the
   legacy ``BENCH_PROFILE`` spelling bench.py shipped with); a no-op
   context manager when unset, so the hook costs nothing in normal runs.
+- :func:`compilation_cache_ctx` — the env-gated persistent XLA
+  compilation cache (``BENCH_COMPILE_CACHE=DIR``) all three drivers
+  (bench.py / serve_bench.py / scale_bench.py) enter at startup: a
+  re-run against a warm cache skips the XLA compile inside
+  compile-warmup, and the ``phases`` section records the cache state
+  (entries before/after) so a warm capture can never masquerade as a
+  cold one. Must be entered BEFORE the first jit dispatch — jax
+  latches its cache-enabled decision at first use.
 """
 
 import contextlib
@@ -58,6 +66,75 @@ def profile_ctx(tool: str = "bench"):
 
         return jax.profiler.trace(trace_dir)
     return contextlib.nullcontext()
+
+
+class CompileCacheInfo:
+    """What the drivers report about the persistent compilation cache:
+    disabled (``enabled False``), or the cache directory plus entry
+    counts at enter and at :meth:`snapshot` time. ``entries_before >
+    0`` is the honest warm-vs-cold label — a warm cache makes
+    compile-warmup seconds incomparable to a cold capture's, and the
+    artifact must say which one it measured."""
+
+    def __init__(self, cache_dir: str | None):
+        self.enabled = cache_dir is not None
+        self.dir = cache_dir
+        self.entries_before = self._count()
+
+    def _count(self) -> int:
+        if not self.enabled:
+            return 0
+        try:
+            return len(os.listdir(self.dir))
+        except OSError:
+            return 0
+
+    def snapshot(self) -> dict | None:
+        """The ``phases.compile_cache`` record: None when disabled
+        (absence means "cold by construction"), else dir + entry
+        counts — ``entries_after > entries_before`` proves this run
+        actually populated the cache for the next one."""
+        if not self.enabled:
+            return None
+        return {"dir": self.dir,
+                "entries_before": self.entries_before,
+                "entries_after": self._count(),
+                "warm": self.entries_before > 0}
+
+
+@contextlib.contextmanager
+def compilation_cache_ctx():
+    """Enter the env-gated persistent XLA compilation cache
+    (``BENCH_COMPILE_CACHE=DIR``): sets ``jax_compilation_cache_dir``
+    (plus the min-compile-time/entry-size floors — the bench's tiny
+    programs would otherwise never be cached) and yields a
+    :class:`CompileCacheInfo`; prior config values are restored on
+    exit. With the env var unset, yields a disabled info object and
+    touches no config. Enter it BEFORE the first jit dispatch: jax
+    checks the cache config once, at first use, and latches."""
+    cache_dir = os.environ.get("BENCH_COMPILE_CACHE")
+    if not cache_dir:
+        yield CompileCacheInfo(None)
+        return
+    os.makedirs(cache_dir, exist_ok=True)
+    import jax
+
+    saved = {
+        "jax_compilation_cache_dir":
+            jax.config.jax_compilation_cache_dir,
+        "jax_persistent_cache_min_compile_time_secs":
+            jax.config.jax_persistent_cache_min_compile_time_secs,
+        "jax_persistent_cache_min_entry_size_bytes":
+            jax.config.jax_persistent_cache_min_entry_size_bytes,
+    }
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    try:
+        yield CompileCacheInfo(cache_dir)
+    finally:
+        for key, val in saved.items():
+            jax.config.update(key, val)
 
 
 def strict_tpu_abort(tool: str, platform: str) -> None:

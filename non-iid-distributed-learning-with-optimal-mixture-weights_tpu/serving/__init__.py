@@ -13,11 +13,16 @@ train->serve loop, plus a checkpoint-watching publisher thread),
 budget, and automatic rollback), ``replica``/``chaos`` (N replicas over
 one compiled ladder behind a health-gating failover router with
 dead-replica requeue and hedged dispatch, proven under seeded
-deterministic chaos). Driven by
+deterministic chaos), ``artifacts`` (the cold-start plane: AOT-export
+the compiled bucket ladder via jax.export + native executables behind
+a typed artifact/host compatibility contract, so a scaling-out
+replica starts in load-milliseconds with zero compiles). Driven by
 ``serve_bench.py`` at the repo root, which emits ``BENCH_SERVE_*.json``
 in the ``bench.py`` schema family with the same strict-backend guard.
 """
 
+from .artifacts import (ArtifactIncompatible, ArtifactManifest,
+                        export_ladder, load_ladder)
 from .batcher import MicroBatcher, coalesce, drain, partition, split_results
 from .chaos import ChaosFault, ChaosPlan, ChaosSpec, resolve_chaos_plan
 from .engine import DEFAULT_BUCKETS, ServingEngine, bucket_for, infer_model
@@ -30,6 +35,8 @@ from .service import (DeadlineExceeded, Overloaded, ServiceStopped,
                       ServingService)
 
 __all__ = [
+    "ArtifactIncompatible",
+    "ArtifactManifest",
     "ChaosFault",
     "ChaosPlan",
     "ChaosSpec",
@@ -56,7 +63,9 @@ __all__ = [
     "bucket_for",
     "coalesce",
     "drain",
+    "export_ladder",
     "infer_model",
+    "load_ladder",
     "partition",
     "resolve_chaos_plan",
     "split_key",

@@ -1,0 +1,510 @@
+"""AOT-exported serving artifacts: the cold-start plane.
+
+A fleet serving heavy traffic cold-starts many replicas, and until now
+every one of them paid the bucket-ladder compile warmup (seconds — the
+``phases.compile_warmup_s`` leg of ``serve_bench.py``) before taking
+its first request; the FedAvg/FedAMW-family models being served are
+tiny, so COMPILE time, not weight load, dominates replica start. This
+module moves that cost to export time, paid once per (program, host
+class), so replica start drops to load-milliseconds:
+
+- :func:`export_ladder` serializes every rung of a warmed
+  :class:`~serving.engine.ServingEngine`'s compiled bucket ladder into
+  an on-disk artifact directory. Each rung is written TWICE, in two
+  deliberately different currencies:
+
+  * ``rung_<b>.stablehlo`` — the **portable program**, via
+    ``jax.export``: versioned StableHLO with a stable calling
+    convention, loadable across jax releases within the export
+    compatibility window. This is the artifact's source of truth — a
+    host whose native payload is incompatible re-materializes (and
+    re-exports) from it instead of re-tracing Python.
+  * ``rung_<b>.xla`` — the **native executable**, via
+    ``jax.experimental.serialize_executable``: the XLA binary itself,
+    the thing whose deserialization is milliseconds and whose first
+    dispatch compiles NOTHING. This is the fast path the cold-start
+    bench pins (``compile_count == 0``), and also the fragile one —
+    it is only valid on a host matching the exporting machine.
+
+- :class:`ArtifactManifest` is the fingerprint that decides which
+  currency a host may spend: jax/jaxlib versions, platform + device
+  kind + machine features, input/feature dtype, the bucket set, the
+  parameter treedef with every leaf's shape/dtype, the RFF draw's
+  shapes, and the source model version/round.
+
+- :func:`load_ladder` validates that manifest against the RUNNING host
+  and raises a typed :class:`ArtifactIncompatible` naming every
+  mismatched field — never a log-line warning. MULTICHIP_r05's tail
+  already showed the XLA:CPU AOT loader emitting its machine-feature
+  mismatch *warning* in the wild; a warning is exactly the wrong
+  interface for "this binary was compiled for a different machine",
+  because a fleet that scales out onto a heterogeneous node pool would
+  serve through mis-tuned (or miscompiling) code paths silently. The
+  contract here is explicit: match -> load in milliseconds; mismatch
+  -> typed refusal telling the operator to re-export on (or for) the
+  new host class.
+
+Weights are NOT part of the artifact. They were jit *arguments* in the
+compiled ladder (the PR 6 hot-swap invariant) and they remain exported-
+call arguments here, so ``swap_weights``/versioned rollout work
+unchanged on an artifact-loaded engine — the checkpoint/registry stays
+the single source of weights, and one exported ladder serves every
+round's model. ``ServingEngine.from_artifact`` wires this in; the
+``serve_bench.py`` ``cold_start`` leg measures it; ``tools/
+export_artifacts.py`` is the operator CLI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+
+import numpy as np
+
+#: Serializes export_ladder bodies: the native compile runs with the
+#: process-global persistent-compile-cache flag toggled off (see the
+#: comment at the toggle), and two concurrent exports racing the
+#: save/restore could leave the cache disabled for the whole process.
+#: The toggle is still process-visible for the export's duration — a
+#: compile on ANOTHER thread inside that window bypasses the
+#: persistent cache once (slower, never wrong); callers that cannot
+#: tolerate even that should export from a dedicated process
+#: (tools/export_artifacts.py), which is also the only safe host for
+#: export when cross-process cache entries may have been loaded.
+_EXPORT_LOCK = threading.Lock()
+
+#: Manifest schema tag. Bump on any field-semantics change: load_ladder
+#: refuses unknown majors, so an old serving box can never misread a
+#: newer manifest as compatible.
+ARTIFACT_SCHEMA = "SERVE_ARTIFACT.v1"
+MANIFEST_NAME = "manifest.json"
+
+#: The padded request-batch dtype the engine dispatches
+#: (``ServingEngine._run`` pads float32); recorded and validated so an
+#: artifact exported under a future dtype change cannot be loaded by an
+#: engine that would feed it differently-typed buffers.
+_INPUT_DTYPE = "float32"
+
+
+class ArtifactIncompatible(RuntimeError):
+    """The artifact cannot run on this host (or under these weights).
+
+    Raised by :func:`load_ladder` / :func:`validate_weights` with the
+    FULL list of mismatched fields — each as ``(field, artifact_value,
+    host_value)`` — so one failed start names every incompatibility at
+    once instead of one per restart. This is the typed replacement for
+    the XLA:CPU AOT loader's machine-feature log warning: artifact/host
+    compatibility is a contract, not advice.
+    """
+
+    def __init__(self, artifact_dir: str, mismatches):
+        self.artifact_dir = str(artifact_dir)
+        self.mismatches = list(mismatches)
+        detail = "; ".join(
+            f"{field}: artifact={a!r} vs host={h!r}"
+            for field, a, h in self.mismatches)
+        super().__init__(
+            f"serving artifact {self.artifact_dir!r} is incompatible "
+            f"with this host: {detail} — re-export on (or for) this "
+            "host class with tools/export_artifacts.py")
+
+
+def _cpu_feature_fingerprint() -> str | None:
+    """Stable digest of the host CPU's feature flags (Linux: the
+    ``flags`` line of /proc/cpuinfo) — the machine-features axis the
+    XLA:CPU AOT loader only warns about. None when unreadable (the
+    manifest then records null and the check is skipped on BOTH sides
+    rather than failing every load on a platform we cannot
+    fingerprint)."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    flags = sorted(line.split(":", 1)[1].split())
+                    blob = " ".join(flags).encode()
+                    return hashlib.sha256(blob).hexdigest()[:16]
+    except OSError:
+        pass
+    return None
+
+
+def host_fingerprint() -> dict:
+    """The running host's side of the compatibility contract — every
+    field the manifest records about the machine that exported. Pure
+    reads (no compilation, no device allocation beyond backend init)."""
+    import platform as _platform
+
+    import jax
+    import jaxlib
+
+    dev = jax.devices()[0]
+    backend = jax.default_backend()
+    return {
+        "jax_version": jax.__version__,
+        "jaxlib_version": jaxlib.__version__,
+        "platform": backend,
+        "device_kind": str(getattr(dev, "device_kind", backend)),
+        "machine": _platform.machine(),
+        "cpu_features": (_cpu_feature_fingerprint()
+                         if backend == "cpu" else None),
+    }
+
+
+def _leaf_sig(x) -> list:
+    """``[shape, dtype]`` of one weight leaf, JSON-shaped."""
+    arr = np.asarray(x)
+    return [list(arr.shape), str(arr.dtype)]
+
+
+@dataclasses.dataclass(frozen=True)
+class ArtifactManifest:
+    """The artifact's identity: what it computes, and where it may run.
+
+    Split in two halves the validators consume separately: the HOST
+    half (:func:`host_fingerprint` fields + ``n_devices`` +
+    ``calling_convention_version``) gates :func:`load_ladder`, and the
+    PROGRAM half (buckets/dtypes/param signature/rff) gates
+    :func:`validate_weights` — so "wrong machine" and "wrong weights"
+    are distinct, fully-named failures.
+    """
+
+    schema: str
+    host: dict            # host_fingerprint() of the exporting machine
+    n_devices: int
+    calling_convention_version: int
+    dtype: str            # padded request-batch dtype
+    feature_dtype: str | None
+    buckets: list
+    input_dim: int
+    num_classes: int
+    param_sig: dict       # weight key -> [shape, dtype]
+    rff_sig: dict | None  # {"W": [shape, dtype], "b": [...]} or None
+    model_version: int | None
+    round_idx: int | None
+    created_at: float
+    rungs: dict           # str(bucket) -> {stablehlo, executable, bytes}
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "ArtifactManifest":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in obj.items() if k in fields})
+
+    def save(self, artifact_dir: str) -> str:
+        path = os.path.join(artifact_dir, MANIFEST_NAME)
+        with open(path, "w") as f:
+            json.dump(self.to_json(), f, indent=1, sort_keys=True)
+        return path
+
+    @classmethod
+    def load(cls, artifact_dir: str) -> "ArtifactManifest":
+        path = os.path.join(artifact_dir, MANIFEST_NAME)
+        try:
+            with open(path) as f:
+                obj = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise ArtifactIncompatible(
+                artifact_dir, [("manifest", f"unreadable ({e})",
+                                "readable manifest.json required")])
+        if not isinstance(obj, dict) or "schema" not in obj:
+            raise ArtifactIncompatible(
+                artifact_dir, [("manifest", obj if not isinstance(
+                    obj, dict) else sorted(obj), "manifest object "
+                    "with a 'schema' field")])
+        if obj["schema"] != ARTIFACT_SCHEMA:
+            # the documented major refusal, enforced BEFORE field
+            # parsing: a future SERVE_ARTIFACT.v2 may rename/re-type
+            # fields, and letting it through would surface as a bare
+            # TypeError (or worse, a silent misread) instead of the
+            # typed contract
+            raise ArtifactIncompatible(
+                artifact_dir,
+                [("schema", obj["schema"], ARTIFACT_SCHEMA)])
+        try:
+            return cls.from_json(obj)
+        except TypeError as e:
+            raise ArtifactIncompatible(
+                artifact_dir, [("manifest", f"malformed ({e})",
+                                f"complete {ARTIFACT_SCHEMA} field "
+                                "set")]) from None
+
+
+def _weight_specs(params, rff):
+    """ShapeDtypeStructs mirroring the engine's installed weights —
+    what every rung is traced/lowered against (weights stay CALL
+    arguments, which is why swaps reuse the exported programs)."""
+    import jax
+
+    p_spec = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype),
+        params)
+    r_spec = None
+    if rff is not None:
+        r_spec = tuple(
+            jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
+            for a in rff)
+    return p_spec, r_spec
+
+
+def export_ladder(engine, out_dir: str, model_version: int | None = None,
+                  round_idx: int | None = None) -> ArtifactManifest:
+    """Serialize every rung of ``engine``'s bucket ladder into
+    ``out_dir`` (created if missing) and return the written manifest.
+
+    Per rung: one ``jax.export`` serialization (the portable program)
+    and one lowered-and-compiled native executable (the fast path).
+    The export pays each rung's compile ONCE, here — that is the whole
+    trade: seconds at export time against milliseconds at every
+    replica start. The engine's serving state is untouched (AOT
+    lowering never enters the jit's dispatch cache).
+
+    ``model_version``/``round_idx`` stamp provenance (which published
+    model's shapes this ladder was exported against) — weights
+    themselves stay OUT of the artifact; any swap-compatible version
+    serves through it.
+    """
+    import jax
+    from jax import export as jax_export
+    from jax.experimental import serialize_executable
+
+    if engine.mesh is not None:
+        raise ValueError(
+            "export_ladder supports single-device engines only: an "
+            "exported executable bakes in its device assignment, and "
+            "a mesh-replicated ladder must be re-exported per mesh "
+            "shape (load the checkpoint without mesh= to export)")
+    os.makedirs(out_dir, exist_ok=True)
+    params, rff, _ = engine._resolve(None)
+    p_spec, r_spec = _weight_specs(params, rff)
+    in_dtype = np.dtype(_INPUT_DTYPE)
+    rungs: dict = {}
+    ccv = None
+    # the native compiles run with the persistent compilation cache
+    # OFF: an executable handed back by a cache HIT (against an entry
+    # a jit DISPATCH wrote) re-serializes with its fusion symbols
+    # stripped — "Symbols not found: [...]" at load — so the artifact
+    # must always hold freshly-compiled binaries; restored after
+    _EXPORT_LOCK.acquire()
+    cache_was = jax.config.jax_enable_compilation_cache
+    if cache_was:
+        jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        for b in engine.buckets:
+            x_spec = jax.ShapeDtypeStruct((int(b), engine.input_dim),
+                                          in_dtype)
+            exported = jax_export.export(engine._predict)(
+                x_spec, p_spec, r_spec)
+            ccv = int(exported.calling_convention_version)
+            hlo_name = f"rung_{int(b)}.stablehlo"
+            with open(os.path.join(out_dir, hlo_name), "wb") as f:
+                f.write(bytes(exported.serialize()))
+            compiled = engine._predict.lower(x_spec, p_spec,
+                                             r_spec).compile()
+            payload, in_tree, out_tree = serialize_executable.serialize(
+                compiled)
+            exe_name = f"rung_{int(b)}.xla"
+            blob = pickle.dumps((payload, in_tree, out_tree))
+            # SELF-CHECK before the blob lands: round-trip it and run
+            # zeros through, bitwise against the direct dispatch. An
+            # XLA:CPU executable compiled in a process that earlier
+            # loaded a CROSS-PROCESS persistent-cache entry serializes
+            # with its fusion symbols stripped ("Symbols not found" at
+            # load) — that corruption must fail the EXPORT, loudly, not
+            # every replica start that trusts the artifact. The fix on
+            # such a host is a fresh exporting process (tools/
+            # export_artifacts.py); the serve bench does exactly that
+            # when BENCH_COMPILE_CACHE is active.
+            x_zero = np.zeros((int(b), engine.input_dim), in_dtype)
+            try:
+                loaded = serialize_executable.deserialize_and_load(
+                    *pickle.loads(blob))
+                got = np.asarray(loaded(x_zero, params, rff))
+            except Exception as e:
+                raise RuntimeError(
+                    f"export self-check failed for rung {int(b)}: the "
+                    "just-serialized executable does not load back "
+                    f"({type(e).__name__}: {e}). This process has "
+                    "likely loaded cross-process persistent-"
+                    "compilation-cache entries, which corrupts XLA:CPU "
+                    "executable serialization — export from a fresh "
+                    "process (tools/export_artifacts.py)") from e
+            # reference = the SAME compiled executable, direct: the
+            # check is of the serialize/deserialize round-trip, and a
+            # jit dispatch here would compile each rung a second time
+            # (AOT lowering never populates the dispatch cache)
+            want = np.asarray(compiled(x_zero, params, rff))
+            if not np.array_equal(got, want):
+                raise RuntimeError(
+                    f"export self-check failed for rung {int(b)}: "
+                    "round-tripped executable disagrees with the "
+                    "direct dispatch — refusing to write a lying "
+                    "artifact")
+            with open(os.path.join(out_dir, exe_name), "wb") as f:
+                f.write(blob)
+            rungs[str(int(b))] = {"stablehlo": hlo_name,
+                                  "executable": exe_name,
+                                  "bytes": len(blob)}
+    finally:
+        if cache_was:
+            jax.config.update("jax_enable_compilation_cache", True)
+        _EXPORT_LOCK.release()
+    manifest = ArtifactManifest(
+        schema=ARTIFACT_SCHEMA,
+        host=host_fingerprint(),
+        n_devices=1,
+        calling_convention_version=int(ccv),
+        dtype=_INPUT_DTYPE,
+        feature_dtype=(None if engine.feature_dtype is None
+                       else str(np.dtype(engine.feature_dtype))),
+        buckets=[int(b) for b in engine.buckets],
+        input_dim=int(engine.input_dim),
+        num_classes=int(engine.num_classes),
+        param_sig={str(k): _leaf_sig(v) for k, v in params.items()},
+        rff_sig=(None if rff is None
+                 else {"W": _leaf_sig(rff[0]), "b": _leaf_sig(rff[1])}),
+        model_version=(None if model_version is None
+                       else int(model_version)),
+        round_idx=None if round_idx is None else int(round_idx),
+        created_at=time.time(),
+        rungs=rungs,
+    )
+    manifest.save(out_dir)
+    return manifest
+
+
+def validate_manifest(manifest: ArtifactManifest,
+                      artifact_dir: str = "<artifact>") -> None:
+    """Raise :class:`ArtifactIncompatible` unless the manifest's host
+    half matches the RUNNING host exactly. Every mismatched field is
+    collected before raising — one refusal names them all."""
+    from jax import export as jax_export
+
+    mismatches = []
+    if str(manifest.schema) != ARTIFACT_SCHEMA:
+        # exact match, not prefix: an unknown major's field semantics
+        # cannot be assumed compatible (the module-docstring contract)
+        mismatches.append(("schema", manifest.schema, ARTIFACT_SCHEMA))
+    host = host_fingerprint()
+    art_host = dict(manifest.host or {})
+    for field in ("jax_version", "jaxlib_version", "platform",
+                  "device_kind", "machine"):
+        if art_host.get(field) != host[field]:
+            mismatches.append((field, art_host.get(field), host[field]))
+    # machine features: checked only when BOTH sides fingerprinted —
+    # an unreadable /proc/cpuinfo must not fail every load, but a
+    # REAL mismatch (the XLA:CPU AOT loader's warning case) is a
+    # refusal, not advice
+    a_feat, h_feat = art_host.get("cpu_features"), host["cpu_features"]
+    if a_feat is not None and h_feat is not None and a_feat != h_feat:
+        mismatches.append(("cpu_features", a_feat, h_feat))
+    if int(manifest.n_devices) != 1:
+        mismatches.append(("n_devices", manifest.n_devices, 1))
+    ccv = int(manifest.calling_convention_version)
+    lo = jax_export.minimum_supported_calling_convention_version
+    hi = jax_export.maximum_supported_calling_convention_version
+    if not lo <= ccv <= hi:
+        mismatches.append(("calling_convention_version", ccv,
+                           f"[{lo}, {hi}]"))
+    if str(manifest.dtype) != _INPUT_DTYPE:
+        mismatches.append(("dtype", manifest.dtype, _INPUT_DTYPE))
+    if mismatches:
+        raise ArtifactIncompatible(artifact_dir, mismatches)
+
+
+def validate_weights(manifest: ArtifactManifest, params, rff,
+                     artifact_dir: str = "<artifact>") -> None:
+    """Raise :class:`ArtifactIncompatible` unless ``params``/``rff``
+    match the signature the ladder was exported against — same weight
+    keys, same leaf shapes and dtypes, same rff-ness. The exported
+    programs take weights as call arguments, so ANY matching version
+    serves through them (the hot-swap invariant); a mismatch would be
+    a shape error deep inside the loaded executable, surfaced here as
+    the typed contract instead."""
+    mismatches = []
+    sig = {str(k): _leaf_sig(v) for k, v in params.items()}
+    want = {str(k): [list(s), str(d)]
+            for k, (s, d) in manifest.param_sig.items()}
+    if sig != want:
+        only_art = sorted(set(want) - set(sig))
+        only_here = sorted(set(sig) - set(want))
+        if only_art or only_here:
+            mismatches.append(("param_keys", sorted(want), sorted(sig)))
+        for k in sorted(set(want) & set(sig)):
+            if want[k] != sig[k]:
+                mismatches.append((f"param[{k}]", want[k], sig[k]))
+    art_rff = manifest.rff_sig
+    if (rff is None) != (art_rff is None):
+        mismatches.append(("rff_fused", art_rff is not None,
+                           rff is not None))
+    elif rff is not None:
+        got = {"W": _leaf_sig(rff[0]), "b": _leaf_sig(rff[1])}
+        want_r = {k: [list(s), str(d)]
+                  for k, (s, d) in art_rff.items()}
+        if got != want_r:
+            mismatches.append(("rff_sig", want_r, got))
+    if mismatches:
+        raise ArtifactIncompatible(artifact_dir, mismatches)
+
+
+def load_ladder(artifact_dir: str) -> tuple[ArtifactManifest, dict]:
+    """Validate + load an artifact directory: returns ``(manifest,
+    {bucket: callable})`` where each callable is the rung's NATIVE
+    deserialized executable — ``fn(x, params, rff)`` with the engine's
+    jit signature, compiling nothing. Any host mismatch raises
+    :class:`ArtifactIncompatible` BEFORE any executable bytes reach
+    the XLA loader (whose own mismatch handling is a warning — the
+    thing this contract replaces); a rung file that is missing or
+    fails to deserialize on a matching host is reported the same typed
+    way (a half-loadable artifact must not half-serve)."""
+    from jax.experimental import serialize_executable
+
+    manifest = ArtifactManifest.load(artifact_dir)
+    validate_manifest(manifest, artifact_dir)
+    rungs: dict = {}
+    problems = []
+    for key, rec in manifest.rungs.items():
+        path = os.path.join(artifact_dir, rec["executable"])
+        try:
+            with open(path, "rb") as f:
+                payload, in_tree, out_tree = pickle.loads(f.read())
+            rungs[int(key)] = serialize_executable.deserialize_and_load(
+                payload, in_tree, out_tree)
+        except ArtifactIncompatible:
+            raise
+        except Exception as e:
+            problems.append((f"rung[{key}]",
+                             f"{type(e).__name__}: {e}",
+                             "loadable native executable"))
+    if problems:
+        raise ArtifactIncompatible(artifact_dir, problems)
+    want = {int(b) for b in manifest.buckets}
+    if set(rungs) != want:
+        raise ArtifactIncompatible(
+            artifact_dir, [("rungs", sorted(rungs), sorted(want))])
+    return manifest, rungs
+
+
+def load_portable(artifact_dir: str, bucket: int):
+    """Deserialize one rung's PORTABLE program (``jax.export``) —
+    the cross-host currency: callable under jit on any host whose jax
+    supports the recorded calling convention, at the cost of one XLA
+    compile of the embedded StableHLO (still no Python re-trace).
+    Used by tests to pin the round-trip and by operators
+    re-materializing on a new host class before re-exporting."""
+    from jax import export as jax_export
+
+    manifest = ArtifactManifest.load(artifact_dir)
+    rec = manifest.rungs.get(str(int(bucket)))
+    if rec is None:
+        raise ArtifactIncompatible(
+            artifact_dir, [("rungs", sorted(manifest.rungs),
+                            f"rung {bucket} present")])
+    with open(os.path.join(artifact_dir, rec["stablehlo"]), "rb") as f:
+        return jax_export.deserialize(bytearray(f.read()))

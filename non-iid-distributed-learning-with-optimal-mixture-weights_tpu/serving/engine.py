@@ -41,6 +41,15 @@ request therefore re-resolves, so it can never run against a
 half-swapped engine. Old weights free by refcount once the last
 in-flight dispatch referencing them returns; the per-call input buffer
 stays donated on TPU as before.
+
+**Cold start** (the ISSUE 9 plane, ``serving/artifacts.py``):
+:meth:`ServingEngine.from_artifact` builds the same engine from an
+AOT-exported ladder instead of compiling one — every rung's program
+deserializes from the artifact's native executables, ``warmup()``
+becomes a no-op, and ``compile_count`` stays 0 through any stream and
+any number of hot swaps (weights are still call arguments). Artifact/
+host compatibility is a typed contract (``ArtifactIncompatible``),
+validated before anything loads.
 """
 
 from __future__ import annotations
@@ -191,6 +200,13 @@ class ServingEngine:
             self._input_dim = int(
                 self.params[self._weight_keys()[0]].shape[1])
         self._shapes_seen: set = set()  # compile-count fallback basis
+        # cold-start plane (serving/artifacts.py): when loaded from an
+        # AOT artifact, _aot maps bucket -> the rung's deserialized
+        # native executable and _run dispatches through it instead of
+        # the jit — the jit cache stays EMPTY (compile_count == 0, the
+        # bench's cold-start pin) and warmup becomes a no-op
+        self._aot: dict | None = None
+        self.artifact_manifest = None
         # host-timed stage split of the most recent predict() call
         # (pad+transfer vs device dispatch), for the request-level
         # trace plane: two perf_counter reads per call, always on.
@@ -435,7 +451,7 @@ class ServingEngine:
              buckets: Sequence[int] = DEFAULT_BUCKETS, mesh=None,
              rff=None, feature_dtype=None,
              input_dim: int | None = None,
-             version: int = 0) -> "ServingEngine":
+             version: int = 0, state: dict | None = None) -> "ServingEngine":
         """Restore a ``save_checkpoint`` directory (either layout) into
         a ready engine. A checkpoint saved with ``rff=setup.rff``
         carries its feature-map draw (``rff_W``/``rff_b``) and the
@@ -456,10 +472,16 @@ class ServingEngine:
         a state with no ``params``) surfaces as a
         ``utils.checkpoint.CheckpointError`` naming the offending path
         — the serving box's operator gets "which file is broken", not
-        a storage-layer traceback mid-construction."""
+        a storage-layer traceback mid-construction.
+
+        ``state``: an already-loaded checkpoint dict for ``path`` — a
+        caller that read the checkpoint for its own markers (e.g. the
+        export CLI reading ``round``) passes it here so a large
+        checkpoint is not read from disk twice."""
         from ..utils.checkpoint import CheckpointError, load_checkpoint
 
-        state = load_checkpoint(path)
+        if state is None:
+            state = load_checkpoint(path)
         if "params" not in state:
             raise CheckpointError(
                 path, "state has no 'params' entry (not a "
@@ -476,6 +498,63 @@ class ServingEngine:
                    feature_dtype=feature_dtype, input_dim=input_dim,
                    version=version)
 
+    @classmethod
+    def from_artifact(cls, artifact_dir: str, checkpoint: str | None = None,
+                      params=None, rff=None, model: Model | str = "auto",
+                      version: int = 0) -> "ServingEngine":
+        """Construct a READY engine from an AOT artifact directory
+        (``serving/artifacts.py:export_ladder``) in load-milliseconds:
+        the bucket ladder's programs deserialize from the artifact's
+        native executables, so :meth:`warmup` is a no-op and
+        ``compile_count`` stays 0 — the cold-start path a scaling-out
+        replica fleet takes instead of paying compile-warmup seconds.
+
+        Weights come from ``checkpoint`` (a ``save_checkpoint`` dir,
+        the production path) or explicit ``params``/``rff`` — NOT from
+        the artifact, which stores programs only; weights remain
+        exported-call arguments, so ``swap_weights``/``install_weights``
+        and the whole rollout plane work unchanged (zero recompiles by
+        construction — there is no jit cache to miss).
+
+        Raises :class:`~serving.artifacts.ArtifactIncompatible` when
+        the artifact's manifest does not match this host (jax/jaxlib
+        version, platform, device kind, machine features, dtype) or
+        when the weights' signature differs from the one the ladder
+        was exported against — typed, never a loader warning.
+        """
+        from .artifacts import load_ladder, validate_weights
+
+        manifest, rungs = load_ladder(artifact_dir)
+        if checkpoint is not None:
+            if params is not None:
+                raise ValueError(
+                    "pass checkpoint= or params=, not both")
+            from ..utils.checkpoint import (CheckpointError,
+                                            load_checkpoint)
+
+            state = load_checkpoint(checkpoint)
+            if "params" not in state:
+                raise CheckpointError(
+                    checkpoint, "state has no 'params' entry (not a "
+                    "save_checkpoint layout?); found keys "
+                    f"{sorted(state)!r}")
+            params = state["params"]
+            if rff is None and "rff_W" in state and "rff_b" in state:
+                rff = (state["rff_W"], state["rff_b"])
+        elif params is None:
+            raise ValueError(
+                "from_artifact needs a weight source: checkpoint= "
+                "(a save_checkpoint dir) or params=")
+        validate_weights(manifest, params, rff, artifact_dir)
+        engine = cls(params, model=model, rff=rff,
+                     buckets=tuple(int(b) for b in manifest.buckets),
+                     mesh=None, feature_dtype=manifest.feature_dtype,
+                     input_dim=int(manifest.input_dim),
+                     version=version)
+        engine._aot = dict(rungs)
+        engine.artifact_manifest = manifest
+        return engine
+
     def _run(self, X: np.ndarray, weights: tuple,
              timings: dict) -> np.ndarray:
         params, rff, v = weights
@@ -490,9 +569,16 @@ class ServingEngine:
         # it to the default device first, a second full copy per call)
         x = (jnp.asarray(X) if self._in_spec is None
              else jax.device_put(X, self._in_spec))
-        self._shapes_seen.add(X.shape)
         t1 = time.perf_counter()
-        out = self._predict(x, params, rff)
+        aot = self._aot.get(b) if self._aot is not None else None
+        if aot is not None:
+            # cold-start path: the rung's deserialized native
+            # executable — no trace, no compile, the jit cache (and so
+            # compile_count) untouched
+            out = aot(x, params, rff)
+        else:
+            self._shapes_seen.add(X.shape)  # compile-count fallback
+            out = self._predict(x, params, rff)
         # np.asarray blocks until ready — predict latency is honest
         out = np.asarray(out)[:n]
         t2 = time.perf_counter()
@@ -559,7 +645,13 @@ class ServingEngine:
 
     def warmup(self) -> int:
         """Compile every bucket (zeros input); returns the compile
-        count, after which a mixed-size stream triggers none."""
+        count, after which a mixed-size stream triggers none. On an
+        artifact-loaded engine (:meth:`from_artifact`) this is a
+        NO-OP returning the (zero) compile count — every rung's
+        program arrived pre-compiled, which is the whole point of the
+        cold-start plane."""
+        if self._aot is not None:
+            return self.compile_count
         d = self.input_dim
         weights = self._resolve(None)
         scratch = {"pad_s": 0.0, "dispatch_s": 0.0}

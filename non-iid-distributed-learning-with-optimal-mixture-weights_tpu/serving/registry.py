@@ -230,11 +230,31 @@ class CheckpointWatcher:
     ``on_publish(version, path)`` runs after each successful publish
     (e.g. to stage a rollout candidate); its exceptions are counted in
     ``errors`` rather than killing the watcher.
+
+    ``artifact_dir`` (the cold-start plane, ``serving/artifacts.py``):
+    when set, every successfully published ``vNNNN`` checkpoint also
+    gets its bucket ladder AOT-exported to ``artifact_dir/vNNNN`` —
+    the publisher-side half of fast replica scale-out, so a new
+    replica can ``ServingEngine.from_artifact`` the newest round
+    without compiling. The export pays each rung's compile on the
+    watcher thread (bounded by ``artifact_buckets``, default the
+    engine ladder); an export failure counts in ``errors`` and is
+    recorded, but the PUBLISH stands — a registry entry must never be
+    withheld because the optional fast-start artifact failed.
+    Successful exports are listed in ``artifacts`` as
+    ``(dirname, artifact_path)``. Caveat for cache-enabled hosts: the
+    export briefly toggles the process-global persistent-compile-cache
+    flag off (exports serialize under a module lock; a compile on
+    another thread inside that window bypasses the cache once), and a
+    process that has loaded CROSS-process cache entries cannot export
+    valid XLA:CPU executables at all — the export self-check refuses
+    and counts an error; use ``tools/export_artifacts.py`` there.
     """
 
     def __init__(self, registry: ModelRegistry, watch_dir: str,
                  poll_interval_s: float = 1.0, metadata: dict | None = None,
-                 on_publish=None):
+                 on_publish=None, artifact_dir: str | None = None,
+                 artifact_buckets=None):
         if poll_interval_s < 0.01:
             raise ValueError(
                 f"poll_interval_s={poll_interval_s} must be >= 0.01 "
@@ -254,7 +274,13 @@ class CheckpointWatcher:
         self._poll_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self.artifact_dir = (None if artifact_dir is None
+                             else str(artifact_dir))
+        self.artifact_buckets = (None if artifact_buckets is None
+                                 else tuple(int(b)
+                                            for b in artifact_buckets))
         self.published: list[tuple[str, int]] = []  # (dirname, version)
+        self.artifacts: list[tuple[str, str]] = []  # (dirname, art path)
         self.errors = 0
         self.polls = 0
 
@@ -300,6 +326,8 @@ class CheckpointWatcher:
             with self._lock:
                 self.published.append((name, v))
             out.append(v)
+            if self.artifact_dir is not None:
+                self._export_artifact(name, path, v)
             if self.on_publish is not None:
                 try:
                     self.on_publish(v, path)
@@ -307,6 +335,31 @@ class CheckpointWatcher:
                     with self._lock:
                         self.errors += 1
         return out
+
+    def _export_artifact(self, name: str, path: str, version: int) -> None:
+        """AOT-export one published checkpoint's ladder beside it (the
+        optional cold-start feed — see class docstring). Failures
+        count in ``errors`` and never unwind the publish."""
+        try:
+            # lazy: registry must stay importable without touching the
+            # engine/export machinery (it never needs an accelerator
+            # unless artifact publishing is actually on)
+            from .artifacts import export_ladder
+            from .engine import ServingEngine
+
+            kw = {}
+            if self.artifact_buckets is not None:
+                kw["buckets"] = self.artifact_buckets
+            engine = ServingEngine.load(path, **kw)
+            out_dir = os.path.join(self.artifact_dir, name)
+            export_ladder(engine, out_dir, model_version=version,
+                          round_idx=self.registry.get(version).round_idx)
+        except Exception:
+            with self._lock:
+                self.errors += 1
+            return
+        with self._lock:
+            self.artifacts.append((name, out_dir))
 
     # -- lifecycle ----------------------------------------------------
     def _run(self) -> None:

@@ -38,8 +38,15 @@ it fail fast (:class:`NoReplicasAvailable`).
 exceeds a latency-percentile threshold (``hedge_percentile`` of
 observed dispatch latency times ``hedge_factor``, floored at
 ``hedge_floor_ms``) is mirrored to the next-healthiest replica and
-the first result wins — the classic tail-taming hedge. The loser is
-abandoned (its health outcome still records when it finishes). Once
+the first result wins — the classic tail-taming hedge. When the
+PRIMARY resolves first, the losing mirror's dispatch is marked
+**cancelled**: its result is discarded when it lands and its outcome
+does NOT count against the replica's circuit breaker or latency EWMA
+(``hedges_cancelled`` fleet counter + per-replica ``cancelled``) — a
+mirror that lost a race it was only drafted into must not distort
+health. A mirror that WINS records normally (``hedge_wins``), and a
+killed mirror still marks its replica dead even when cancelled (a
+chaos kill is a fact about the replica, not about the race). Once
 the threshold arms, EVERY dispatch — primary and mirror — runs
 out-of-band (``record_timings=False``): two threads racing into the
 engine's single-consumer timing slot would cross-bill the serving
@@ -259,6 +266,13 @@ class ReplicaHealth:
             self._open_since = now
             self._half_open = False
 
+    def on_cancelled(self) -> None:
+        """A drafted hedge mirror's outcome was DISCARDED: release the
+        half-open probe slot the pick may hold (leaking it would bench
+        the replica forever) without recording success or failure —
+        the circuit state and EWMA stay exactly as they were."""
+        self._probe_inflight = False
+
     def on_dead(self) -> None:
         self.dead = True
         self._half_open = False
@@ -317,11 +331,13 @@ class FailoverRouter:
             failure_threshold, cooldown_s, ewma_alpha)
             for r in self.replicas}
         self._counts = {r.replica_id: {"routed": 0, "ok": 0,
-                                       "failed": 0, "requeued": 0}
+                                       "failed": 0, "requeued": 0,
+                                       "cancelled": 0}
                         for r in self.replicas}
         self.requeues = 0
         self.hedges = 0
         self.hedge_wins = 0
+        self.hedges_cancelled = 0
         self._rr = 0  # round-robin cursor (mutated under the lock)
         self._hist = LatencyHistogram(max_samples=4096)
         self._pool: ThreadPoolExecutor | None = None
@@ -468,13 +484,25 @@ class FailoverRouter:
             return {"replicas": reps, "requeues": self.requeues,
                     "hedges": self.hedges,
                     "hedge_wins": self.hedge_wins,
+                    "hedges_cancelled": self.hedges_cancelled,
                     "dead_replicas": dead}
 
     # -- dispatch -----------------------------------------------------
-    def _attempt(self, rep: Replica, X, version, record_timings):
+    def _attempt(self, rep: Replica, X, version, record_timings,
+                 cancel: threading.Event | None = None):
         """One replica dispatch with health + counter accounting.
         Returns ``(out, timing)``; raises the replica's failure after
-        recording it (the caller decides whether to fail over)."""
+        recording it (the caller decides whether to fail over).
+
+        ``cancel`` (hedge mirrors only): when set by the time the
+        dispatch completes, the outcome is DISCARDED from health
+        accounting — no circuit-breaker failure, no EWMA sample, no
+        ok/failed count; the per-replica ``cancelled`` counter records
+        it instead. A :class:`ReplicaDead` still marks the replica
+        dead (a kill is a fact about the replica, not the race). The
+        check is best-effort by construction: a mirror whose dispatch
+        completed in the instant before the winner set the flag has
+        already recorded a genuine observation, which is harmless."""
         rid = rep.replica_id
         with self._lock:
             self._counts[rid]["routed"] += 1
@@ -483,16 +511,36 @@ class FailoverRouter:
             out = rep.predict(X, version=version,
                               record_timings=record_timings)
         except ReplicaDead:
+            cancelled = cancel is not None and cancel.is_set()
             with self._lock:
                 self._health[rid].on_dead()
-                self._counts[rid]["failed"] += 1
+                if cancelled:
+                    self._counts[rid]["cancelled"] += 1
+                else:
+                    self._counts[rid]["failed"] += 1
             raise
         except Exception:
+            cancelled = cancel is not None and cancel.is_set()
             with self._lock:
-                self._health[rid].on_failure(time.perf_counter())
-                self._counts[rid]["failed"] += 1
+                if cancelled:
+                    self._counts[rid]["cancelled"] += 1
+                    self._health[rid].on_cancelled()
+                else:
+                    self._health[rid].on_failure(time.perf_counter())
+                    self._counts[rid]["failed"] += 1
             raise
         dt = time.perf_counter() - t0
+        if cancel is not None and cancel.is_set():
+            # the race is already answered: hand the result back (the
+            # caller discards it) without letting a drafted mirror's
+            # latency or success touch this replica's health; the
+            # half-open probe slot it may hold is released so the
+            # replica is not benched by a discarded observation
+            with self._lock:
+                self._counts[rid]["cancelled"] += 1
+                self._health[rid].on_cancelled()
+            return out, {"pad_s": 0.0, "dispatch_s": dt, "bucket": 0,
+                         "version": version}
         # fallback model-version attribution when the engine's timing
         # slot is unavailable (untimed hedged attempts skip it): a
         # pinned dispatch (version=N, e.g. the rollout's candidate
@@ -597,8 +645,9 @@ class FailoverRouter:
             return out, attributed(timing), rep, False
         with self._lock:
             self.hedges += 1
+        cancel_mirror = threading.Event()
         mirror = pool.submit(self._attempt, mirror_rep, X, version,
-                             False)
+                             False, cancel_mirror)
         pending = {primary: rep, mirror: mirror_rep}
         last_exc: BaseException | None = None
         while pending:
@@ -614,6 +663,22 @@ class FailoverRouter:
                 if who is mirror_rep:
                     with self._lock:
                         self.hedge_wins += 1
+                elif mirror in pending and not mirror.done():
+                    # the primary resolved first: mark the losing
+                    # mirror's STILL-RUNNING dispatch CANCELLED — its
+                    # eventual result is discarded in _attempt
+                    # without touching its replica's health/EWMA
+                    # (the PR 7 follow-on; counters: fleet
+                    # hedges_cancelled here, per-replica 'cancelled'
+                    # at the discarded completion). A mirror that
+                    # already completed (both futures in one wake)
+                    # recorded a genuine outcome — cancelling it now
+                    # would only desync the two counters; the tiny
+                    # done()-to-flag-check window remains best-effort
+                    # by construction (see _attempt)
+                    cancel_mirror.set()
+                    with self._lock:
+                        self.hedges_cancelled += 1
                 return out, attributed(timing), who, True
         assert last_exc is not None
         raise last_exc

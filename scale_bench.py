@@ -317,6 +317,16 @@ def cohort_stream():
 
 
 def main():
+    # the persistent-compile-cache satellite (BENCH_COMPILE_CACHE=DIR,
+    # shared with bench.py/serve_bench.py): entered before the first
+    # jit dispatch; the artifact records the warm/cold cache state
+    from bench_common import compilation_cache_ctx
+
+    with compilation_cache_ctx() as ccache:
+        _main(ccache)
+
+
+def _main(ccache):
     if os.environ.get("JAX_PLATFORMS"):
         # honor the env var under the container's sitecustomize (which
         # force-registers the axon TPU plugin): the config update must
@@ -367,6 +377,10 @@ def main():
             # the cohort section the schema gate validates: the
             # million-client streamed leg's abort-grade counters
             "cohort": cohort_rec,
+            # warm-vs-cold compile-cache state (None = no cache =
+            # cold by construction), same contract as the bench
+            # drivers' phases.compile_cache
+            "compile_cache": ccache.snapshot(),
         }
         with open(artifact, "w") as f:
             json.dump(art, f, indent=1)

@@ -65,6 +65,19 @@ grows a ``chaos`` section (kills/requeues/hedge-wins counters,
 per-replica health, p95 with vs without chaos) and the schema bumps to
 BENCH_SERVE.v3.
 
+The ISSUE 9 cold-start leg (``cold_start``, schema BENCH_SERVE.v4)
+pins the two replica start modes side by side: compile-warmup start
+(fresh engine + warmup, one XLA compile per rung — what every replica
+paid until now) vs artifact-load start (``serving/artifacts.py``: the
+ladder AOT-exported once via jax.export + native executables, then
+``ServingEngine.from_artifact`` deserializing it in milliseconds).
+Abort-grade: the artifact path must come up AND serve every rung with
+``compile_count == 0``, and must pass the same engine-vs-evaluate
+parity gate as the compiled path. The chaos leg is additionally
+composed with a MID-STREAM hot weight swap (chaos-under-rollout, the
+PR 7 follow-on): zero lost requests, zero recompiles, and the correct
+NEW model_version on every post-swap span are abort-grade.
+
 Env knobs: SERVE_BUCKETS ("1,8,64,512"), SERVE_D (RFF width, 256),
 SERVE_N (train rows, 4096), SERVE_CLIENTS (8), SERVE_TRAIN_ROUNDS (2),
 SERVE_ITERS (per-bucket timed calls, 30), SERVE_REQUESTS (mixed-stream
@@ -77,8 +90,13 @@ an existing checkpoint dir instead
 of training), SERVE_OUT, SERVE_ROUND (artifact suffix, default 1),
 SERVE_TRACE (directory: export the traced leg's span records as JSONL
 there, and stream the rollout leg's spans there as rotating parts),
-BENCH_PROFILE_DIR (jax.profiler capture of the timed section, shared
-with bench.py via bench_common.profile_ctx).
+SERVE_ARTIFACT_DIR (keep the cold-start leg's exported AOT artifact
+there instead of scratch), BENCH_COMPILE_CACHE (directory: persistent
+XLA compilation cache for the whole run — warm/cold state recorded in
+phases.compile_cache; shared with bench.py/scale_bench.py via
+bench_common.compilation_cache_ctx), BENCH_PROFILE_DIR (jax.profiler
+capture of the timed section, shared with bench.py via
+bench_common.profile_ctx).
 """
 
 import json
@@ -391,14 +409,18 @@ def chaos_bench(engine, n_requests, max_wait_ms):
     """The ISSUE 7 failover leg: the mixed stream re-run over a
     3-replica fleet (one shared compiled ladder) behind the
     FailoverRouter, first clean, then under a SCRIPTED chaos plan that
-    wedges one replica (hedged past) and kills two mid-stream. The
-    acceptance pins are abort-grade, like parity: every accepted
+    wedges one replica (hedged past) and kills two mid-stream — now
+    COMPOSED with a mid-stream hot weight swap (the ISSUE 9
+    chaos-under-rollout follow-on): halfway through the chaos stream
+    the live version is swapped while replicas are dying around it.
+    The acceptance pins are abort-grade, like parity: every accepted
     request must resolve (success or explicit DeadlineExceeded — none
     lost or hung), every request id must land exactly one span, at
     least one scripted kill must actually fire (a chaos leg that never
-    exercised failover proves nothing), and the compile count must
-    stay flat across kills and failovers. Returns the artifact
-    ``chaos`` section (BENCH_SERVE.v3)."""
+    exercised failover proves nothing), the compile count must stay
+    flat across kills, failovers AND the swap, and every request
+    submitted after the swap must carry the NEW model_version on its
+    span. Returns the artifact ``chaos`` section (BENCH_SERVE.v4)."""
     from fedamw_tpu.serving import (ChaosPlan, DeadlineExceeded,
                                     FailoverRouter, ReplicaSet,
                                     ServingService)
@@ -410,22 +432,41 @@ def chaos_bench(engine, n_requests, max_wait_ms):
     payloads = [rng.randn(s, engine.input_dim).astype(np.float32)
                 for s in sizes]
     cc0 = engine.compile_count
+    # the swap's weights: the live version re-installed under a new
+    # number — this leg measures swap MECHANICS under chaos (correct
+    # version on every post-swap span, zero recompiles), and identical
+    # weights keep the chaos/clean latency comparison apples-to-apples
+    swap_params = {k: np.asarray(v) for k, v in engine.params.items()}
+    swap_rff = engine.rff
+    if swap_rff is not None:
+        swap_rff = (np.asarray(swap_rff[0]), np.asarray(swap_rff[1]))
 
-    def stream(router, tracer=None):
+    def stream(router, tracer=None, swap_at=None):
         """Paced request stream (many small batches, so the scripted
         per-replica dispatch indices land mid-stream, not in one
         giant coalesce); every future is awaited with a hard timeout
-        — a hung request surfaces as 'lost', never as a green run."""
+        — a hung request surfaces as 'lost', never as a green run.
+        ``swap_at``: submit index at which the live weights hot-swap
+        mid-stream; request ids submitted after it are returned so
+        the caller can pin their spans to the new version."""
         ok = deadline = lost = 0
-        submitted = []
+        submitted, post_swap = [], []
+        swap_ver = None
         with ServingService(router, max_wait_ms=max_wait_ms,
                             max_queue=max(1024, n_requests),
                             tracer=tracer) as svc:
             futs = []
             for i in range(n_requests):
+                if swap_at is not None and i == swap_at:
+                    # the chaos-under-rollout composition: swap while
+                    # replicas are being killed around the dispatch
+                    swap_ver = router.swap_weights(swap_params,
+                                                   rff=swap_rff)
                 f = svc.submit(payloads[i % len(payloads)],
                                timeout_s=30.0)
                 submitted.append(f.request_id)
+                if swap_ver is not None:
+                    post_swap.append(f.request_id)
                 futs.append(f)
                 time.sleep(0.0015)
             for f in futs:
@@ -439,13 +480,14 @@ def chaos_bench(engine, n_requests, max_wait_ms):
                           f"{type(e).__name__}: {e}", file=sys.stderr)
                     lost += 1
             snap = svc.metrics.snapshot(router)
-        return snap, ok, deadline, lost, submitted
+        return snap, ok, deadline, lost, submitted, swap_ver, post_swap
 
     # clean baseline: same fleet shape, no chaos — the p95 the chaos
     # tail is judged against
     with FailoverRouter(ReplicaSet(engine, n_replicas),
                         policy="round_robin") as clean_router:
-        clean_snap, clean_ok, _, clean_lost, _ = stream(clean_router)
+        clean_snap, clean_ok, _, clean_lost, _, _, _ = \
+            stream(clean_router)
 
     # scripted chaos, deterministic every run: replica 1 dies on its
     # 3rd dispatch, replica 0 wedges on its 4th (the hedge masks the
@@ -468,13 +510,22 @@ def chaos_bench(engine, n_requests, max_wait_ms):
                         policy="round_robin", hedge=True,
                         hedge_min_samples=6,
                         hedge_floor_ms=50.0) as router:
-        snap, ok, deadline, lost, submitted = stream(router, tracer)
+        snap, ok, deadline, lost, submitted, swap_ver, post_swap = \
+            stream(router, tracer, swap_at=n_requests // 2)
         fo = snap["failover"]
 
     req_spans = [r for r in tracer.records() if r["name"] == "request"]
     ids = [r["trace_id"] for r in req_spans]
     spans_once = (sorted(ids) == sorted(submitted)
                   and tracer.dropped == 0)
+    # chaos-under-rollout pin: every request submitted AFTER the swap
+    # returned must report the NEW version on its span — whichever
+    # surviving replica served it, and whether it resolved ok or shed
+    # on deadline (the version dimension must never lie under chaos)
+    post_ids = set(post_swap)
+    post_versions = {r["attrs"].get("model_version")
+                     for r in req_spans if r["trace_id"] in post_ids}
+    swap_ok = bool(post_swap) and post_versions == {swap_ver}
     recompiles = engine.compile_count - cc0
     section = {
         "replicas": n_replicas,
@@ -495,15 +546,120 @@ def chaos_bench(engine, n_requests, max_wait_ms):
         "p50_ms_chaos": snap["p50_ms"],
         "recompiles_during_chaos": recompiles,
         "spans_exactly_once": spans_once,
+        "midstream_swap_version": swap_ver,
+        "post_swap_requests": len(post_swap),
+        "post_swap_version_ok": swap_ok,
+        "hedges_cancelled": fo["hedges_cancelled"],
         "per_replica": fo["replicas"],
     }
     if (section["lost"] or recompiles or not spans_once
             or fo["dead_replicas"] < 1
-            or clean_ok != n_requests):
+            or clean_ok != n_requests or not swap_ok):
         # abort-grade, like parity: a lost/hung request, a recompile
-        # under failover, a lost span, or a chaos schedule that never
-        # fired must not emit green-looking numbers
+        # under failover (or under the mid-stream swap), a lost span,
+        # a chaos schedule that never fired, or a post-swap span
+        # carrying the wrong model version must not emit green-looking
+        # numbers
         print(f"# serve_bench aborted: chaos leg failed "
+              f"({json.dumps(section)})", file=sys.stderr)
+        raise SystemExit(1)
+    return section
+
+
+def cold_start_bench(ckpt, buckets, setup, X_test_raw):
+    """The ISSUE 9 cold-start leg: the two ways a replica can come up,
+    timed side by side from the SAME checkpoint. Compile-warmup start
+    — a fresh ``ServingEngine.load`` + ``warmup()``, one XLA compile
+    per rung (what every replica paid until now) — vs artifact-load
+    start: ``export_ladder`` once (the cost the exporter pays, timed
+    separately), then ``ServingEngine.from_artifact`` deserializing
+    the pre-compiled ladder. Abort-grade pins: the artifact path must
+    come up with ``compile_count == 0`` and KEEP it at 0 after serving
+    every rung (a single compile on the load path means the artifact
+    did not actually serve), and its logits must reproduce
+    ``fedcore/evaluate.py``'s accuracy exactly — the same parity gate
+    the compiled path passes. Returns the artifact ``cold_start``
+    section (BENCH_SERVE.v4). SERVE_ARTIFACT_DIR keeps the exported
+    artifact; otherwise it is scratch."""
+    from fedamw_tpu.serving import ServingEngine
+    from fedamw_tpu.serving.artifacts import export_ladder
+
+    t0 = time.perf_counter()
+    cold = ServingEngine.load(ckpt, buckets=buckets)
+    compiled = cold.warmup()
+    compile_warmup_s = time.perf_counter() - t0
+
+    scratch = None
+    art_dir = os.environ.get("SERVE_ARTIFACT_DIR")
+    if not art_dir:
+        art_dir = scratch = tempfile.mkdtemp(prefix="serve_artifact_")
+    try:
+        t0 = time.perf_counter()
+        if os.environ.get("BENCH_COMPILE_CACHE"):
+            # with the persistent compile cache active, this process
+            # may have loaded cross-process cache entries — which
+            # corrupts XLA:CPU executable serialization (export_ladder
+            # self-checks and refuses). Export from a FRESH process
+            # via the operator CLI instead: the export cost then
+            # includes interpreter+jax startup, which is exactly what
+            # an operator's export step costs anyway.
+            import subprocess
+
+            from fedamw_tpu.serving.artifacts import ArtifactManifest
+
+            env = dict(os.environ)
+            env.pop("BENCH_COMPILE_CACHE", None)
+            cli = os.path.join(os.path.dirname(os.path.abspath(
+                __file__)), "tools", "export_artifacts.py")
+            run = subprocess.run(
+                [sys.executable, cli, ckpt, art_dir, "--buckets",
+                 ",".join(str(b) for b in buckets)],
+                env=env, capture_output=True, text=True, timeout=300)
+            if run.returncode != 0:
+                print(f"# serve_bench aborted: artifact export CLI "
+                      f"failed: {run.stderr[-1000:]}", file=sys.stderr)
+                raise SystemExit(1)
+            manifest = ArtifactManifest.load(art_dir)
+        else:
+            manifest = export_ladder(cold, art_dir)
+        export_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        art = ServingEngine.from_artifact(art_dir, checkpoint=ckpt)
+        art.warmup()  # the no-op: nothing to compile is the point
+        load_s = time.perf_counter() - t0
+
+        parity = None
+        if setup is not None:
+            parity = check_parity(art, setup, X_test_raw)
+        # serve every rung once THROUGH the loaded executables: the
+        # zero stays zero, or the leg aborts
+        rng = np.random.RandomState(11)
+        for b in art.buckets:
+            art.predict(rng.randn(b, art.input_dim).astype(np.float32))
+        cc = art.compile_count
+    finally:
+        if scratch is not None:
+            shutil.rmtree(scratch, ignore_errors=True)
+    section = {
+        "compile_warmup_s": round(compile_warmup_s, 3),
+        "compile_count_compiled": compiled,
+        "artifact_export_s": round(export_s, 3),
+        "artifact_load_s": round(load_s, 4),
+        "artifact_compile_count": cc,
+        "speedup_x": (round(compile_warmup_s / load_s, 1)
+                      if load_s > 0 else None),
+        "rungs": len(manifest.rungs),
+        "artifact_bytes": sum(r["bytes"]
+                              for r in manifest.rungs.values()),
+        "parity": parity,
+        "artifact_dir": None if scratch else art_dir,
+    }
+    if cc != 0 or (parity is not None and not parity["match"]):
+        # abort-grade, like parity: an artifact path that compiled
+        # anything, or serves different numbers than training
+        # evaluated, must never emit green cold-start seconds
+        print(f"# serve_bench aborted: cold-start leg failed "
               f"({json.dumps(section)})", file=sys.stderr)
         raise SystemExit(1)
     return section
@@ -513,7 +669,8 @@ def main():
     # shared prologue with bench.py (bench_common): re-apply
     # JAX_PLATFORMS over the container's sitecustomize, then the
     # BENCH_STRICT_TPU certification abort on the RESOLVED backend
-    from bench_common import reapply_jax_platforms, strict_tpu_abort
+    from bench_common import (compilation_cache_ctx,
+                              reapply_jax_platforms, strict_tpu_abort)
 
     reapply_jax_platforms()
     import jax
@@ -533,29 +690,36 @@ def main():
     ckpt = os.environ.get("SERVE_CKPT")
     setup = None
     scratch = None  # our own train-and-serve checkpoint, removed on exit
-    t_build0 = time.perf_counter()
-    if ckpt:
-        engine = ServingEngine.load(ckpt, buckets=buckets)
-        print(f"# serving existing checkpoint {ckpt}", file=sys.stderr)
-    else:
-        ckpt = scratch = tempfile.mkdtemp(prefix="serve_ckpt_")
-        setup, X_test_raw = build_checkpoint(
-            ckpt, D=D, n=_env_int("SERVE_N", 4096),
-            clients=_env_int("SERVE_CLIENTS", 8),
-            rounds=_env_int("SERVE_TRAIN_ROUNDS", 2))
-        engine = ServingEngine.load(ckpt, buckets=buckets)
-    build_s = time.perf_counter() - t_build0
-    try:
-        _run_bench(engine, setup, X_test_raw if setup is not None
-                   else None, ckpt, platform, iters, n_requests,
-                   max_wait_ms, build_s)
-    finally:
-        if scratch is not None:
-            shutil.rmtree(scratch, ignore_errors=True)
+    # the persistent-compile-cache satellite: entered BEFORE the first
+    # jit dispatch (jax latches the cache decision at first use), so
+    # with BENCH_COMPILE_CACHE set, training build AND every engine
+    # compile below go through the cache — phases.compile_cache
+    # records cold vs warm
+    with compilation_cache_ctx() as ccache:
+        t_build0 = time.perf_counter()
+        if ckpt:
+            engine = ServingEngine.load(ckpt, buckets=buckets)
+            print(f"# serving existing checkpoint {ckpt}",
+                  file=sys.stderr)
+        else:
+            ckpt = scratch = tempfile.mkdtemp(prefix="serve_ckpt_")
+            setup, X_test_raw = build_checkpoint(
+                ckpt, D=D, n=_env_int("SERVE_N", 4096),
+                clients=_env_int("SERVE_CLIENTS", 8),
+                rounds=_env_int("SERVE_TRAIN_ROUNDS", 2))
+            engine = ServingEngine.load(ckpt, buckets=buckets)
+        build_s = time.perf_counter() - t_build0
+        try:
+            _run_bench(engine, setup, X_test_raw if setup is not None
+                       else None, ckpt, platform, iters, n_requests,
+                       max_wait_ms, build_s, ccache)
+        finally:
+            if scratch is not None:
+                shutil.rmtree(scratch, ignore_errors=True)
 
 
 def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
-               n_requests, max_wait_ms, build_s):
+               n_requests, max_wait_ms, build_s, ccache=None):
 
     parity = None
     if setup is not None:
@@ -657,6 +821,19 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
     chaos_s = time.perf_counter() - t_chaos0
     print(f"# {format_failover_report(chaos)}", file=sys.stderr)
 
+    # ISSUE 9: the cold-start leg — compile-warmup start vs
+    # artifact-load start from the same checkpoint, side by side; the
+    # artifact path must come up AND serve with compile_count == 0
+    t_cold0 = time.perf_counter()
+    engine_buckets = tuple(engine.buckets)
+    cold = cold_start_bench(ckpt, engine_buckets, setup, X_test_raw)
+    cold_s = time.perf_counter() - t_cold0
+    print(f"# cold start: compile-warmup {cold['compile_warmup_s']}s "
+          f"vs artifact load {cold['artifact_load_s']}s "
+          f"({cold['speedup_x']}x; export paid once: "
+          f"{cold['artifact_export_s']}s, artifact compile_count "
+          f"{cold['artifact_compile_count']})", file=sys.stderr)
+
     # the zero-recompile pin now spans EVERY stream — untraced, traced,
     # and the rollout leg's swapped versions: tracing must not perturb
     # the shape discipline, and neither may a weight swap
@@ -697,11 +874,12 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
 
     artifact = {
         "metric": "serve_bench",
-        # v3: the chaos section (replica-fleet failover leg) joins the
-        # v2 rollout section in the contract — tools/
+        # v4: the cold_start section (AOT artifact leg) and the
+        # chaos leg's mid-stream-swap pins join the v3 chaos and v2
+        # rollout sections in the contract — tools/
         # check_bench_schema.py requires each from its version on
         # (earlier artifacts are grandfathered by schema version)
-        "schema": "BENCH_SERVE.v3",
+        "schema": "BENCH_SERVE.v4",
         "platform": platform,
         "engine": {
             "buckets": list(engine.buckets),
@@ -716,11 +894,19 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
                    "compile_warmup_s": round(warmup_s, 3),
                    "timed_run_s": round(timed_s, 3),
                    "rollout_s": round(loop_s, 3),
-                   "chaos_s": round(chaos_s, 3)},
+                   "chaos_s": round(chaos_s, 3),
+                   "cold_start_s": round(cold_s, 3),
+                   # None when BENCH_COMPILE_CACHE is unset (cold by
+                   # construction); else dir + entry counts, so a
+                   # warm-cache compile_warmup_s can never be read as
+                   # a cold capture's
+                   "compile_cache": (ccache.snapshot()
+                                     if ccache is not None else None)},
         "bucket_latency": bucket_latency,
         "mixed_stream": stream,
         "rollout": rollout,
         "chaos": chaos,
+        "cold_start": cold,
         "trace": {
             "request_spans": len(req_spans),
             "unique_request_ids": len(set(ids)),
@@ -774,6 +960,22 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
         "inflight_p95_ms": rollout["inflight_p95_ms"],
         "recompiles_during_swaps": rollout["recompiles_during_swaps"],
         "final_version": rollout["final_version"],
+        "platform": platform,
+    }))
+
+    # the cold-start line (before the headline, which stays LAST): the
+    # number a fleet operator sizes scale-out by — milliseconds to a
+    # ready, zero-compile replica vs the compile-warmup seconds it
+    # replaces
+    print(json.dumps({
+        "metric": "serve_cold_start",
+        "value": round(cold["artifact_load_s"] * 1e3, 3),
+        "unit": "ms-to-ready",
+        "compile_warmup_s": cold["compile_warmup_s"],
+        "artifact_export_s": cold["artifact_export_s"],
+        "speedup_x": cold["speedup_x"],
+        "artifact_compile_count": cold["artifact_compile_count"],
+        "rungs": cold["rungs"],
         "platform": platform,
     }))
 

@@ -1,0 +1,420 @@
+"""The cold-start plane: AOT serving artifacts and their typed
+compatibility contract (ISSUE 9).
+
+Load-bearing guarantees:
+
+- **Manifest round-trip**: ``export_ladder`` writes an
+  ``ArtifactManifest`` whose on-disk JSON reloads field-for-field, and
+  ``load_ladder`` on the same host validates it clean.
+- **Typed incompatibility**: a manifest mismatched on ANY contract
+  field — jaxlib version, platform, machine features, dtype, buckets
+  (a rung file withheld), weight signature — raises
+  :class:`ArtifactIncompatible` naming the field. NEVER a warning:
+  this is the explicit replacement for the XLA:CPU AOT loader's
+  machine-feature log line (MULTICHIP_r05).
+- **from_artifact parity**: the artifact-loaded engine reproduces the
+  compiled-path engine's logits bitwise on every rung, comes up with
+  ``compile_count == 0``, keeps it at 0 across a mixed-size stream
+  (``warmup()`` is a no-op), and chunks oversized batches identically.
+- **Zero-recompile swap on the artifact path**: weights are
+  exported-call arguments, so ``swap_weights``/``install_weights``/
+  versioned dispatch work unchanged on an artifact-loaded engine with
+  the compile count pinned at 0 — there is no jit cache to miss.
+- **Watcher publishing** (satellite): ``CheckpointWatcher(
+  artifact_dir=...)`` exports an artifact beside every published
+  vNNNN checkpoint; an export failure counts in ``errors`` without
+  unwinding the publish.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from fedamw_tpu.serving import (ArtifactIncompatible, ArtifactManifest,
+                                CheckpointWatcher, ModelRegistry,
+                                ServingEngine, export_ladder,
+                                load_ladder)
+from fedamw_tpu.serving.artifacts import (host_fingerprint,
+                                          load_portable,
+                                          validate_weights)
+from fedamw_tpu.utils.checkpoint import save_checkpoint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+D, C = 12, 3
+BUCKETS = (1, 4, 8)
+
+
+def make_engine(rff=True, seed=1, buckets=BUCKETS):
+    rng = np.random.RandomState(seed)
+    kw = {}
+    if rff:
+        kw["rff"] = (rng.randn(6, D).astype(np.float32),
+                     rng.randn(D).astype(np.float32))
+    e = ServingEngine({"w": rng.randn(C, D).astype(np.float32)},
+                      buckets=buckets, **kw)
+    e.warmup()
+    return e
+
+
+def host_weights(engine):
+    params = {k: np.asarray(v) for k, v in engine.params.items()}
+    rff = engine.rff
+    if rff is not None:
+        rff = (np.asarray(rff[0]), np.asarray(rff[1]))
+    return params, rff
+
+
+def _tamper(art_dir, mutate):
+    """Edit the manifest JSON in place through ``mutate(obj)``."""
+    path = os.path.join(art_dir, "manifest.json")
+    with open(path) as f:
+        obj = json.load(f)
+    mutate(obj)
+    with open(path, "w") as f:
+        json.dump(obj, f)
+
+
+# -- manifest ----------------------------------------------------------
+
+def test_manifest_round_trips_field_for_field(tmp_path):
+    engine = make_engine()
+    m = export_ladder(engine, str(tmp_path), model_version=7,
+                      round_idx=42)
+    m2 = ArtifactManifest.load(str(tmp_path))
+    assert m2 == m  # frozen dataclass equality: every field survived
+    assert m2.model_version == 7 and m2.round_idx == 42
+    assert m2.buckets == list(BUCKETS)
+    assert m2.host == host_fingerprint()
+    assert sorted(m2.rungs) == [str(b) for b in sorted(BUCKETS)]
+    for rec in m2.rungs.values():
+        assert rec["bytes"] > 0
+        assert os.path.exists(os.path.join(str(tmp_path),
+                                           rec["stablehlo"]))
+        assert os.path.exists(os.path.join(str(tmp_path),
+                                           rec["executable"]))
+
+
+def test_load_ladder_clean_on_exporting_host(tmp_path):
+    engine = make_engine()
+    export_ladder(engine, str(tmp_path))
+    manifest, rungs = load_ladder(str(tmp_path))
+    assert sorted(rungs) == sorted(BUCKETS)
+    # the loaded rung IS the program: callable on (x, params, rff)
+    params, rff, _ = engine._resolve(None)
+    X = np.random.RandomState(0).randn(4, engine.input_dim).astype(
+        np.float32)
+    out = np.asarray(rungs[4](X, params, rff))
+    np.testing.assert_array_equal(out, engine.predict(X))
+
+
+@pytest.mark.parametrize("field, mutate", [
+    ("jaxlib_version",
+     lambda o: o["host"].__setitem__("jaxlib_version", "9.9.9")),
+    ("jax_version",
+     lambda o: o["host"].__setitem__("jax_version", "0.0.1")),
+    ("platform",
+     lambda o: o["host"].__setitem__("platform", "tpu")),
+    ("device_kind",
+     lambda o: o["host"].__setitem__("device_kind", "TPU v4")),
+    ("machine",
+     lambda o: o["host"].__setitem__("machine", "armv7l")),
+    ("dtype", lambda o: o.__setitem__("dtype", "bfloat16")),
+    ("n_devices", lambda o: o.__setitem__("n_devices", 8)),
+    ("calling_convention_version",
+     lambda o: o.__setitem__("calling_convention_version", 99999)),
+])
+def test_each_host_field_mismatch_raises_typed(tmp_path, field, mutate):
+    """Each contract field individually: tampering it (and nothing
+    else) must raise ArtifactIncompatible NAMING that field — never a
+    warning, never a silent load."""
+    engine = make_engine()
+    export_ladder(engine, str(tmp_path))
+    _tamper(str(tmp_path), mutate)
+    params, rff = host_weights(engine)
+    with pytest.raises(ArtifactIncompatible) as ei:
+        ServingEngine.from_artifact(str(tmp_path), params=params,
+                                    rff=rff)
+    assert any(field == f for f, _, _ in ei.value.mismatches), \
+        f"{field} not named in {ei.value.mismatches}"
+
+
+def test_cpu_feature_mismatch_raises_typed(tmp_path):
+    """The machine-features axis the XLA:CPU AOT loader only WARNS
+    about: a fingerprint recorded by the exporter that differs from
+    the running host is a typed refusal here."""
+    engine = make_engine()
+    export_ladder(engine, str(tmp_path))
+    m = ArtifactManifest.load(str(tmp_path))
+    if m.host["cpu_features"] is None:
+        pytest.skip("host CPU features not fingerprintable here")
+    _tamper(str(tmp_path),
+            lambda o: o["host"].__setitem__("cpu_features", "deadbeef"))
+    with pytest.raises(ArtifactIncompatible) as ei:
+        load_ladder(str(tmp_path))
+    assert any(f == "cpu_features" for f, _, _ in ei.value.mismatches)
+
+
+def test_unknown_schema_major_refused_typed(tmp_path):
+    """A future SERVE_ARTIFACT.v2 may rename or re-type fields, so an
+    unknown major is refused BEFORE field parsing — typed, naming the
+    schema — and a same-major manifest with a missing field surfaces
+    as a typed malformed-manifest refusal, never a bare TypeError."""
+    engine = make_engine()
+    export_ladder(engine, str(tmp_path))
+    _tamper(str(tmp_path),
+            lambda o: o.__setitem__("schema", "SERVE_ARTIFACT.v2"))
+    with pytest.raises(ArtifactIncompatible) as ei:
+        ArtifactManifest.load(str(tmp_path))
+    assert any(f == "schema" for f, _, _ in ei.value.mismatches)
+    export_ladder(engine, str(tmp_path))  # restore
+    _tamper(str(tmp_path), lambda o: o.pop("param_sig"))
+    with pytest.raises(ArtifactIncompatible) as ei:
+        load_ladder(str(tmp_path))
+    assert any("malformed" in str(a)
+               for _, a, _ in ei.value.mismatches)
+
+
+def test_bucket_tamper_and_missing_rung_raise_typed(tmp_path):
+    engine = make_engine()
+    export_ladder(engine, str(tmp_path))
+    # a manifest claiming a rung whose file is absent: typed, named
+    _tamper(str(tmp_path), lambda o: o["rungs"].__setitem__(
+        "64", {"stablehlo": "rung_64.stablehlo",
+               "executable": "rung_64.xla", "bytes": 1}))
+    with pytest.raises(ArtifactIncompatible) as ei:
+        load_ladder(str(tmp_path))
+    assert any("rung[64]" == f for f, _, _ in ei.value.mismatches)
+
+
+def test_damaged_manifest_and_executable_raise_typed(tmp_path):
+    engine = make_engine()
+    export_ladder(engine, str(tmp_path))
+    # truncate one executable: deserialization failure is typed too
+    exe = os.path.join(str(tmp_path), "rung_4.xla")
+    with open(exe, "wb") as f:
+        f.write(b"\x80corrupt")
+    with pytest.raises(ArtifactIncompatible):
+        load_ladder(str(tmp_path))
+    # and a directory with no manifest at all
+    with pytest.raises(ArtifactIncompatible):
+        load_ladder(str(tmp_path / "nowhere"))
+
+
+def test_weight_signature_mismatch_raises_typed(tmp_path):
+    engine = make_engine()
+    export_ladder(engine, str(tmp_path))
+    params, rff = host_weights(engine)
+    rng = np.random.RandomState(9)
+    # wrong leaf shape
+    with pytest.raises(ArtifactIncompatible) as ei:
+        ServingEngine.from_artifact(
+            str(tmp_path),
+            params={"w": rng.randn(C, D + 1).astype(np.float32)},
+            rff=rff)
+    assert any(f.startswith("param[") for f, _, _ in ei.value.mismatches)
+    # wrong leaf dtype (the per-field dtype half of the contract)
+    with pytest.raises(ArtifactIncompatible):
+        ServingEngine.from_artifact(
+            str(tmp_path),
+            params={"w": params["w"].astype(np.float64)}, rff=rff)
+    # rff-ness flipped: structurally different program
+    with pytest.raises(ArtifactIncompatible) as ei:
+        ServingEngine.from_artifact(str(tmp_path), params=params,
+                                    rff=None)
+    assert any(f == "rff_fused" for f, _, _ in ei.value.mismatches)
+    # validate_weights alone names extra/missing keys
+    with pytest.raises(ArtifactIncompatible) as ei:
+        validate_weights(ArtifactManifest.load(str(tmp_path)),
+                         {"w": params["w"], "b1": params["w"]}, rff)
+    assert any(f == "param_keys" for f, _, _ in ei.value.mismatches)
+
+
+# -- from_artifact parity + zero compiles ------------------------------
+
+def test_from_artifact_parity_and_zero_compiles(tmp_path):
+    engine = make_engine()
+    export_ladder(engine, str(tmp_path))
+    params, rff = host_weights(engine)
+    art = ServingEngine.from_artifact(str(tmp_path), params=params,
+                                      rff=rff)
+    assert art.compile_count == 0
+    assert art.warmup() == 0  # the no-op: nothing to compile
+    assert art.compile_count == 0
+    assert art.buckets == engine.buckets
+    rng = np.random.RandomState(3)
+    # every rung boundary + single rows + an oversized chunked batch
+    for n in [1, 2, 4, 5, 8, 3, 1, 20]:
+        X = rng.randn(n, engine.input_dim).astype(np.float32)
+        np.testing.assert_array_equal(art.predict(X),
+                                      engine.predict(X))
+    assert art.compile_count == 0  # served everything, compiled nothing
+    assert art.artifact_manifest is not None
+
+
+def test_from_artifact_via_checkpoint_dir(tmp_path):
+    """The production path: weights come from the checkpoint, programs
+    from the artifact — export once, serve any round."""
+    rng = np.random.RandomState(5)
+    params = {"w": rng.randn(C, D).astype(np.float32)}
+    rff = (rng.randn(6, D).astype(np.float32),
+           rng.randn(D).astype(np.float32))
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(ckpt, params, p=np.ones(2) / 2, round_idx=3,
+                    rff=rff)
+    engine = ServingEngine.load(ckpt, buckets=BUCKETS)
+    engine.warmup()
+    art_dir = str(tmp_path / "artifact")
+    export_ladder(engine, art_dir, round_idx=3)
+    art = ServingEngine.from_artifact(art_dir, checkpoint=ckpt)
+    X = rng.randn(7, engine.input_dim).astype(np.float32)
+    np.testing.assert_array_equal(art.predict(X), engine.predict(X))
+    assert art.compile_count == 0
+    with pytest.raises(ValueError, match="not both"):
+        ServingEngine.from_artifact(art_dir, checkpoint=ckpt,
+                                    params=params)
+    with pytest.raises(ValueError, match="weight source"):
+        ServingEngine.from_artifact(art_dir)
+
+
+def test_artifact_engine_zero_recompile_swap(tmp_path):
+    """The hot-swap invariant survives the artifact path: weights are
+    exported-call arguments, so install/swap/versioned dispatch reuse
+    the loaded executables with the compile count pinned at ZERO."""
+    engine = make_engine()
+    export_ladder(engine, str(tmp_path))
+    params, rff = host_weights(engine)
+    art = ServingEngine.from_artifact(str(tmp_path), params=params,
+                                      rff=rff)
+    rng = np.random.RandomState(7)
+    X = rng.randn(5, art.input_dim).astype(np.float32)
+    base = art.predict(X)
+    # stage a candidate, dispatch it PINNED, then promote
+    w2 = {"w": rng.randn(C, D).astype(np.float32)}
+    art.install_weights(1, w2, rff=rff)
+    cand = art.predict(X, version=1)
+    assert not np.array_equal(cand, base)
+    art.swap_weights(version=1)
+    np.testing.assert_array_equal(art.predict(X), cand)
+    # install-and-flip spelling too
+    v = art.swap_weights({"w": -w2["w"]}, rff=rff)
+    assert art.version == v
+    assert art.compile_count == 0  # across ALL of it
+    # swap-compat checks still guard the artifact engine
+    with pytest.raises(ValueError, match="swap-incompatible"):
+        art.swap_weights({"w": rng.randn(C, D + 2).astype(np.float32)},
+                         rff=rff)
+
+
+def test_portable_rung_round_trips_and_matches(tmp_path):
+    """The jax.export half: the portable StableHLO rung deserializes
+    and reproduces the engine bitwise (under one fresh jit compile) —
+    the cross-host currency a new host class re-materializes from."""
+    import jax
+
+    engine = make_engine()
+    export_ladder(engine, str(tmp_path))
+    exported = load_portable(str(tmp_path), 4)
+    assert jax.default_backend() in exported.platforms
+    params, rff, _ = engine._resolve(None)
+    X = np.random.RandomState(1).randn(4, engine.input_dim).astype(
+        np.float32)
+    out = np.asarray(jax.jit(exported.call)(X, params, rff))
+    np.testing.assert_array_equal(out, engine.predict(X))
+    with pytest.raises(ArtifactIncompatible):
+        load_portable(str(tmp_path), 4096)  # no such rung
+
+
+def test_export_refuses_mesh_engines(tmp_path):
+    engine = make_engine()
+    engine.mesh = object()  # an exported program bakes in devices
+    with pytest.raises(ValueError, match="single-device"):
+        export_ladder(engine, str(tmp_path))
+
+
+def test_pre_mapped_engine_exports_without_rff(tmp_path):
+    """The no-RFF layout (pre-mapped features) round-trips too — rff
+    absence is structural and recorded as such."""
+    engine = make_engine(rff=False)
+    m = export_ladder(engine, str(tmp_path))
+    assert m.rff_sig is None
+    params, _ = host_weights(engine)
+    art = ServingEngine.from_artifact(str(tmp_path), params=params)
+    X = np.random.RandomState(2).randn(3, D).astype(np.float32)
+    np.testing.assert_array_equal(art.predict(X), engine.predict(X))
+    assert art.compile_count == 0
+
+
+# -- watcher + CLI (satellites) ----------------------------------------
+
+def _publish_ckpt(dirpath, seed=11):
+    rng = np.random.RandomState(seed)
+    save_checkpoint(str(dirpath), {"w": rng.randn(C, D).astype(
+        np.float32)}, p=np.ones(2) / 2, round_idx=seed)
+
+
+def test_watcher_publishes_artifacts_beside_checkpoints(tmp_path):
+    watch = tmp_path / "ckpts"
+    art_root = tmp_path / "artifacts"
+    watch.mkdir()
+    _publish_ckpt(watch / "v0001", seed=1)
+    _publish_ckpt(watch / "v0002", seed=2)
+    reg = ModelRegistry()
+    w = CheckpointWatcher(reg, str(watch), artifact_dir=str(art_root),
+                          artifact_buckets=(1, 4))
+    assert w.poll_once() == [1, 2]
+    assert [n for n, _ in w.artifacts] == ["v0001", "v0002"]
+    assert w.errors == 0
+    # each artifact cold-starts an engine against ITS checkpoint
+    for name, art_dir in w.artifacts:
+        eng = ServingEngine.from_artifact(
+            art_dir, checkpoint=str(watch / name))
+        assert eng.compile_count == 0
+        assert eng.buckets == (1, 4)
+        m = ArtifactManifest.load(art_dir)
+        assert m.model_version == dict(w.published)[name]
+
+
+def test_watcher_artifact_failure_counts_not_fatal(tmp_path):
+    """An unexportable checkpoint (here: artifact_dir is an unwritable
+    path) must count in errors WITHOUT unwinding the publish."""
+    watch = tmp_path / "ckpts"
+    watch.mkdir()
+    _publish_ckpt(watch / "v0001")
+    blocked = tmp_path / "blocked"
+    blocked.write_text("a file where a directory must go")
+    reg = ModelRegistry()
+    w = CheckpointWatcher(reg, str(watch),
+                          artifact_dir=str(blocked / "sub"),
+                          artifact_buckets=(1,))
+    assert w.poll_once() == [1]  # the publish stands
+    assert w.errors == 1 and w.artifacts == []
+
+
+def test_export_artifacts_cli_exports_and_checks(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    rng = np.random.RandomState(4)
+    rff = (rng.randn(6, D).astype(np.float32),
+           rng.randn(D).astype(np.float32))
+    save_checkpoint(str(ckpt), {"w": rng.randn(C, D).astype(
+        np.float32)}, p=np.ones(2) / 2, round_idx=5, rff=rff)
+    out_dir = tmp_path / "artifact"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "export_artifacts.py"),
+         str(ckpt), str(out_dir), "--buckets", "1,4", "--check"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["rungs"] == 2 and summary["bytes"] > 0
+    assert summary["round_idx"] == 5
+    assert summary["check"]["compile_count"] == 0
+    assert summary["check"]["parity"] == "bitwise"
+    # and the artifact the CLI wrote serves in-process too
+    eng = ServingEngine.from_artifact(str(out_dir),
+                                      checkpoint=str(ckpt))
+    assert eng.compile_count == 0

@@ -188,6 +188,78 @@ def test_serve_v3_rejects_chaos_drift(tmp_path):
         assert any(needle in e for e in cbs.validate_file(p))
 
 
+GOOD_COLD = {"compile_warmup_s": 2.4, "compile_count_compiled": 4,
+             "artifact_export_s": 1.1, "artifact_load_s": 0.012,
+             "artifact_compile_count": 0, "speedup_x": 200.0,
+             "rungs": 4, "artifact_bytes": 120000,
+             "parity": {"match": True}}
+
+#: v4 chaos carries the mid-stream-swap pins on top of the v3 shape
+GOOD_CHAOS_V4 = dict(GOOD_CHAOS, midstream_swap_version=4,
+                     post_swap_requests=60, post_swap_version_ok=True,
+                     hedges_cancelled=0)
+
+
+def _serve_art_v4(**extra):
+    art = _serve_art(schema="BENCH_SERVE.v4",
+                     chaos=dict(GOOD_CHAOS_V4),
+                     cold_start=dict(GOOD_COLD))
+    art.update(extra)
+    return art
+
+
+def test_serve_v4_requires_cold_start_section(tmp_path):
+    """From schema v4 on, the AOT-artifact leg's 'cold_start' section
+    is contract; v3 artifacts predate it and stay valid."""
+    assert cbs.validate_file(
+        _write(tmp_path, "BENCH_SERVE_r09.json", _serve_art_v4())) == []
+    art = _serve_art_v4()
+    del art["cold_start"]
+    errs = cbs.validate_file(_write(tmp_path, "BENCH_SERVE_r09.json",
+                                    art))
+    assert any("'cold_start' section" in e for e in errs)
+    # v3 stays valid without the section (pre-ISSUE-9 shape)
+    v3 = _serve_art(schema="BENCH_SERVE.v3", chaos=dict(GOOD_CHAOS))
+    assert cbs.validate_file(
+        _write(tmp_path, "BENCH_SERVE_r09.json", v3)) == []
+
+
+def test_serve_v4_rejects_cold_start_drift(tmp_path):
+    # both start modes must be present and timed
+    for key, bad in (("compile_warmup_s", None),
+                     ("compile_warmup_s", 0),
+                     ("artifact_load_s", None),
+                     ("artifact_load_s", 0),
+                     ("artifact_export_s", "fast"),
+                     ("rungs", 0)):
+        cold = dict(GOOD_COLD, **{key: bad})
+        p = _write(tmp_path, "BENCH_SERVE_r09.json",
+                   _serve_art_v4(cold_start=cold))
+        assert cbs.validate_file(p), f"accepted broken cold {key}={bad}"
+    # the abort-grade pin, re-checked at the gate: a compiled start
+    # wearing the AOT label must never land green
+    p = _write(tmp_path, "BENCH_SERVE_r09.json", _serve_art_v4(
+        cold_start=dict(GOOD_COLD, artifact_compile_count=3)))
+    assert any("compile NOTHING" in e for e in cbs.validate_file(p))
+
+
+def test_serve_v4_rejects_midstream_swap_drift(tmp_path):
+    """The chaos-under-rollout pins ride the v4 chaos section: the
+    swap must actually precede some requests, and every post-swap span
+    must have carried the new version."""
+    p = _write(tmp_path, "BENCH_SERVE_r09.json", _serve_art_v4(
+        chaos=dict(GOOD_CHAOS_V4, post_swap_requests=0)))
+    assert any("post_swap_requests" in e for e in cbs.validate_file(p))
+    p = _write(tmp_path, "BENCH_SERVE_r09.json", _serve_art_v4(
+        chaos=dict(GOOD_CHAOS_V4, post_swap_version_ok=False)))
+    assert any("post_swap_version_ok" in e
+               for e in cbs.validate_file(p))
+    # v3 artifacts never carried the swap fields: still valid there
+    v3 = _serve_art(schema="BENCH_SERVE.v3", chaos=dict(GOOD_CHAOS))
+    assert cbs.validate_file(
+        _write(tmp_path, "BENCH_SERVE_r09.json", v3)) == []
+
+
 def test_rejects_multichip_ok_rc_disagreement(tmp_path):
     p = _write(tmp_path, "MULTICHIP_r09.json",
                {"n_devices": 8, "rc": 124, "ok": True, "tail": "OK"})

@@ -363,6 +363,167 @@ def test_hedge_both_fail_excludes_mirror_from_requeue_walk():
         assert router.pop_timings()["replica"] == 0
 
 
+def _wait_for(cond, timeout_s=5.0):
+    """Poll until ``cond()`` (the abandoned hedge loser finishes on a
+    pool thread; its discarded accounting lands asynchronously)."""
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < timeout_s:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+def test_primary_win_cancels_mirror_failure_health_untouched():
+    """The ISSUE 9 hedge-cancellation follow-on: when the PRIMARY
+    resolves first, the losing mirror's dispatch is marked cancelled —
+    its eventual FAILURE is discarded without opening the mirror's
+    circuit or touching its EWMA, counted under ``hedges_cancelled``/
+    per-replica ``cancelled`` instead of ``failed``/``requeued``."""
+    engine = make_engine()
+    # r0 is slow (not failing) on its 3rd dispatch — long enough to
+    # cross the hedge threshold, fast enough to beat the mirror; the
+    # mirror r1 wedges 0.6s on its 3rd dispatch and then fails
+    plan = ChaosPlan.scripted(2, slow={0: [2]}, wedges={1: [2]},
+                              slow_mult=400.0, wedge_s=0.6, horizon=64)
+    with FailoverRouter(ReplicaSet(engine, 2, chaos=plan),
+                        policy="round_robin", hedge=True,
+                        hedge_min_samples=4, hedge_factor=2.0,
+                        hedge_floor_ms=1.0) as router:
+        for k in range(4):  # r0 d0, r1 d0, r0 d1, r1 d1: all clean
+            router.predict(rows(2, seed=k))
+        assert router._hedge_timeout_s() is not None
+        ewma_before = router.replica_stats()["replicas"]["1"]["ewma_ms"]
+        X = rows(3, seed=99)
+        out = router.predict(X)  # r0 slow -> mirrored to r1 -> r0 wins
+        np.testing.assert_array_equal(out, engine.predict(X))
+        assert router.hedges == 1 and router.hedge_wins == 0
+        assert router.hedges_cancelled == 1
+        # the discarded mirror outcome lands on a pool thread later
+        assert _wait_for(lambda: router.replica_stats()
+                         ["replicas"]["1"]["cancelled"] == 1)
+        stats = router.replica_stats()
+        assert stats["hedges_cancelled"] == 1
+        r1 = stats["replicas"]["1"]
+        # the wedge-then-fail was DISCARDED: no failure, no requeue,
+        # circuit closed, EWMA exactly what the clean dispatches left
+        assert r1["failed"] == 0 and r1["requeued"] == 0
+        assert r1["state"] == "closed"
+        assert r1["ewma_ms"] == ewma_before
+        assert stats["requeues"] == 0
+        timing = router.pop_timings()
+        assert timing["replica"] == 0 and timing["hedged"] is True
+
+
+class _SleepyReplica(Replica):
+    """Chaos-free replica with an exact per-dispatch stall AFTER the
+    (successful) engine call — slow, never failing: what the
+    cancelled-success case needs and rate/mult chaos cannot script
+    deterministically (one plan-wide slow_mult would make primary and
+    mirror photo-finish)."""
+
+    def __init__(self, replica_id, engine, sleeps):
+        super().__init__(replica_id, engine, None)
+        self._sleeps = dict(sleeps)  # dispatch index -> seconds
+
+    def predict(self, X, version=None, record_timings=True):
+        k = self.dispatches
+        out = super().predict(X, version=version,
+                              record_timings=record_timings)
+        s = self._sleeps.get(k, 0.0)
+        if s:
+            time.sleep(s)
+        return out
+
+
+def test_primary_win_cancels_mirror_success_no_ewma_sample():
+    """A cancelled mirror that SUCCEEDS is discarded the same way: no
+    ok count, no EWMA sample, no hedge win — a race it was only
+    drafted into must not distort its health either way."""
+    engine = make_engine()
+    # on the hedged dispatch (each replica's 3rd): primary r0 stalls
+    # 60ms — past the ~1-2ms hedge threshold, far under the mirror's
+    # 600ms — so the primary wins with a wide deterministic margin
+    # and the mirror's SUCCESS lands half a second after cancellation
+    reps = [_SleepyReplica(0, engine, {2: 0.06}),
+            _SleepyReplica(1, engine, {2: 0.6})]
+    with FailoverRouter(reps, policy="round_robin", hedge=True,
+                        hedge_min_samples=4, hedge_factor=2.0,
+                        hedge_floor_ms=1.0) as router:
+        for k in range(4):
+            router.predict(rows(2, seed=k))
+        assert router._hedge_timeout_s() is not None
+        before = router.replica_stats()["replicas"]["1"]
+        X = rows(3, seed=42)
+        out = router.predict(X)
+        np.testing.assert_array_equal(out, engine.predict(X))
+        assert router.hedges == 1
+        assert router.hedges_cancelled == 1
+        assert _wait_for(lambda: router.replica_stats()
+                         ["replicas"]["1"]["cancelled"] == 1)
+        after = router.replica_stats()["replicas"]["1"]
+        assert router.hedge_wins == 0
+        assert after["ok"] == before["ok"]  # success discarded
+        assert after["ewma_ms"] == before["ewma_ms"]
+
+
+def test_cancelled_failure_releases_half_open_probe_slot():
+    """A half-open replica drafted as a hedge mirror whose CANCELLED
+    dispatch fails must get its probe slot back: the cancelled branch
+    skips on_failure (which normally clears the in-flight probe), and
+    leaking the slot would bench a live replica forever."""
+    engine = make_engine()
+    plan = ChaosPlan.scripted(2, flaky={1: [0, 1]}, horizon=16)
+    router = FailoverRouter(ReplicaSet(engine, 2, chaos=plan),
+                            failure_threshold=1, cooldown_s=0.01)
+    h = router._health[1]
+    X = rows(2)
+    with pytest.raises(ChaosFault):  # r1 d0 flaky: circuit opens
+        router._attempt(router.replicas[1], X, None, False)
+    assert h.state == "open"
+    time.sleep(0.02)
+    assert h.available(time.perf_counter())  # cooldown -> half-open
+    h.on_probe()  # the pick consumed the single probe slot
+    assert not h.available(time.perf_counter())
+    cancel = threading.Event()
+    cancel.set()  # the primary already won this race
+    with pytest.raises(ChaosFault):  # r1 d1 flaky, CANCELLED
+        router._attempt(router.replicas[1], X, None, False, cancel)
+    # outcome discarded — failures unchanged, circuit state kept —
+    # but the probe slot is free again: the replica stays routable
+    assert h.failures == 1
+    assert h.available(time.perf_counter())
+    assert router.replica_stats()["replicas"]["1"]["cancelled"] == 1
+
+
+def test_cancelled_mirror_kill_still_marks_dead():
+    """Cancellation discards the HEALTH observation, not the fact of
+    death: a chaos kill landing on a cancelled mirror still marks the
+    replica dead (it is gone for every future dispatch), while the
+    failed/requeued counters stay clean."""
+    engine = make_engine()
+    plan = ChaosPlan.scripted(2, slow={0: [2]}, kills={1: 2},
+                              slow_mult=400.0, horizon=64)
+    with FailoverRouter(ReplicaSet(engine, 2, chaos=plan),
+                        policy="round_robin", hedge=True,
+                        hedge_min_samples=4, hedge_factor=2.0,
+                        hedge_floor_ms=1.0) as router:
+        for k in range(4):
+            router.predict(rows(2, seed=k))
+        X = rows(3, seed=7)
+        out = router.predict(X)  # r0 slow -> mirror r1 killed instantly
+        np.testing.assert_array_equal(out, engine.predict(X))
+        # the kill raises immediately — usually BEFORE the slow
+        # primary returns, in which case it counts as a genuine
+        # failure (cancel was not yet set); either way the replica is
+        # dead and nothing was requeued (the primary answered)
+        assert _wait_for(lambda: router.replica_stats()
+                         ["replicas"]["1"]["state"] == "dead")
+        stats = router.replica_stats()
+        assert stats["replicas"]["1"]["requeued"] == 0
+        assert stats["requeues"] == 0
+
+
 def test_untimed_dispatch_attributes_pinned_version():
     """Hedged-mode attempts run untimed (record_timings=False) and so
     skip the engine's timing slot — the fallback attribution must

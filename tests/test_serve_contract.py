@@ -17,8 +17,12 @@ in every request span, and the rollout leg's spans STREAMED through
 rotating JSONL parts; the ISSUE 7 chaos leg — scripted replica kills
 mid-stream on a 3-replica fleet with zero lost requests, dead-replica
 requeues, zero recompiles across failovers, and the p95-with/without-
-chaos comparison in a v3 ``chaos`` section; and the strict-backend
-guard — BENCH_STRICT_TPU
+chaos comparison in a v3 ``chaos`` section; the ISSUE 9 cold-start
+leg — compile-warmup start vs AOT-artifact-load start side by side in
+a v4 ``cold_start`` section, the artifact path coming up AND serving
+with ``compile_count == 0``, plus the chaos leg composed with a
+mid-stream hot swap whose new model_version lands on every post-swap
+span; and the strict-backend guard — BENCH_STRICT_TPU
 must abort rc=1 on a leaked CPU backend BEFORE measuring anything,
 exactly like bench.py, so a CPU capture can never be harvested as TPU
 evidence.
@@ -85,7 +89,7 @@ def test_serve_bench_emits_driver_contract_json(tmp_path):
     # replicas' in-flight batches requeued, nothing was lost, and the
     # shared-ladder zero-recompile pin covers the failovers
     chaos_lines = [l for l in lines if l["metric"] == "serve_chaos"]
-    assert len(chaos_lines) == 1 and chaos_lines[0] == lines[-4]
+    assert len(chaos_lines) == 1 and chaos_lines[0] == lines[-5]
     cl = chaos_lines[0]
     assert cl["kills"] >= 1
     assert cl["requeues"] >= 1
@@ -94,12 +98,23 @@ def test_serve_bench_emits_driver_contract_json(tmp_path):
     assert cl["value"] > 0  # p95 under chaos
     assert cl["p95_ms_clean"] > 0
 
-    # ISSUE 6 pins — the rollout line prints before the trace-overhead
+    # ISSUE 9 pins — the cold-start line prints between the rollout
+    # and trace-overhead lines (headline still LAST): the artifact
+    # path came up in positive milliseconds having compiled NOTHING
+    cold_lines = [l for l in lines if l["metric"] == "serve_cold_start"]
+    assert len(cold_lines) == 1 and cold_lines[0] == lines[-3]
+    cold_l = cold_lines[0]
+    assert cold_l["value"] > 0  # ms-to-ready on the artifact path
+    assert cold_l["artifact_compile_count"] == 0
+    assert cold_l["compile_warmup_s"] > 0
+    assert cold_l["rungs"] == 3
+
+    # ISSUE 6 pins — the rollout line prints before the cold-start
     # line (headline still LAST): swaps took, the shadow canary
     # promoted, the parity drill rolled back, and the zero-recompile
     # pin covers the swapped streams
     roll_lines = [l for l in lines if l["metric"] == "serve_rollout"]
-    assert len(roll_lines) == 1 and roll_lines[0] == lines[-3]
+    assert len(roll_lines) == 1 and roll_lines[0] == lines[-4]
     roll = roll_lines[0]
     assert roll["swaps"] >= 3
     assert roll["canary"] == "promoted"
@@ -110,7 +125,7 @@ def test_serve_bench_emits_driver_contract_json(tmp_path):
     # the artifact mirrors the lines and carries the parity verdict
     with open(out_path) as f:
         art = json.load(f)
-    assert art["schema"] == "BENCH_SERVE.v3"
+    assert art["schema"] == "BENCH_SERVE.v4"
     assert art["recompiles_after_warmup"] == 0
     assert len(art["bucket_latency"]) >= 3
     assert art["parity"]["match"] is True
@@ -181,6 +196,31 @@ def test_serve_bench_emits_driver_contract_json(tmp_path):
     assert len(dead) == 2
     assert all(r["requeued"] == 1 for r in dead)
     assert art["phases"]["chaos_s"] >= 0
+
+    # the chaos-under-rollout composition (ISSUE 9 satellite): a hot
+    # swap landed MID-chaos-stream, every request submitted after it
+    # carried the new version, and the recompile pin covered the swap
+    assert chaos["post_swap_requests"] >= 1
+    assert chaos["post_swap_version_ok"] is True
+    assert isinstance(chaos["midstream_swap_version"], int)
+    assert chaos["hedges_cancelled"] >= 0
+
+    # the cold-start section: the AOT-artifact evidence the v4 schema
+    # requires (tools/check_bench_schema.py gates it) — both start
+    # modes timed, zero compiles on the load path, exact parity
+    cold = art["cold_start"]
+    assert cold["compile_warmup_s"] > 0
+    assert cold["compile_count_compiled"] == 3  # one per rung
+    assert cold["artifact_export_s"] > 0
+    assert cold["artifact_load_s"] > 0
+    assert cold["artifact_compile_count"] == 0
+    assert cold["speedup_x"] > 1  # load beats compile, or why bother
+    assert cold["rungs"] == 3 and cold["artifact_bytes"] > 0
+    assert cold["parity"]["match"] is True
+    assert cold["parity"]["engine_acc"] == cold["parity"]["evaluate_acc"]
+    assert art["phases"]["cold_start_s"] >= 0
+    # no BENCH_COMPILE_CACHE in this run: cold by construction
+    assert art["phases"]["compile_cache"] is None
 
     # the mixed stream predates any swap: served by the seed version,
     # zero staleness, and the new dimensions are present

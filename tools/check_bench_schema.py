@@ -33,7 +33,12 @@ Three artifact families, three rule sets:
   hedge-win counts, p95 with AND without chaos, zero lost requests,
   and zero recompiles during chaos — the abort-grade pins the bench
   enforces, re-checked here so a hand-edited artifact can never land
-  green.
+  green. From schema v4 on, the ``cold_start`` section (the ISSUE 9
+  AOT-artifact leg) is required as well: both replica start modes
+  present and timed (compile-warmup vs artifact load), the load
+  path's ``artifact_compile_count == 0``, plus the chaos section's
+  mid-stream-swap pins (positive ``post_swap_requests``,
+  ``post_swap_version_ok`` true).
 - ``MULTICHIP_rNN.json`` — the dryrun wrapper: ``n_devices``/``rc``/
   ``ok``/``tail``, with ``ok`` true iff ``rc == 0`` (a disagreeing
   pair is exactly the silent-green failure this tool exists to catch).
@@ -152,6 +157,7 @@ def check_serve_artifact(art: dict, name: str) -> list[str]:
                     "zero-recompile pin reads it)")
     errs.extend(_check_rollout_section(art, schema))
     errs.extend(_check_chaos_section(art, schema))
+    errs.extend(_check_cold_start_section(art, schema))
     return errs
 
 
@@ -255,6 +261,61 @@ def _check_chaos_section(art: dict, schema: str) -> list[str]:
     if chaos.get("spans_exactly_once") is not True:
         errs.append("chaos: 'spans_exactly_once' must be true (every "
                     "accepted request id lands one span)")
+    return errs
+
+
+def _check_cold_start_section(art: dict, schema: str) -> list[str]:
+    """The v4+ ``cold_start`` contract (the AOT-artifact leg): BOTH
+    replica start modes must be present and timed (compile-warmup
+    start vs artifact-load start), and the abort-grade pin — the
+    artifact path came up and served with ``compile_count == 0`` — is
+    re-checked here so a hand-edited artifact can never land a
+    compiled "cold start" as an AOT one. v4 also extends the chaos
+    section with the mid-stream-swap pins (chaos-under-rollout).
+    Earlier schema versions predate the leg and are grandfathered."""
+    if not schema.startswith("BENCH_SERVE."):
+        return []  # family error already reported by the caller
+    version = _schema_version(schema)
+    if version is None:
+        return []  # the rollout check already reported it
+    if version < 4:
+        return []
+    cold = art.get("cold_start")
+    if not isinstance(cold, dict):
+        errs = ["schema v4+ requires a 'cold_start' section (the "
+                "AOT-artifact leg)"]
+    else:
+        errs = []
+        # both start modes, timed: a section with only one mode never
+        # made the comparison the leg exists for
+        for key in ("compile_warmup_s", "artifact_load_s",
+                    "artifact_export_s"):
+            if not isinstance(cold.get(key), (int, float)) \
+                    or cold[key] <= 0:
+                errs.append(f"cold_start: missing positive numeric "
+                            f"{key!r} (both start modes must be "
+                            "present and timed)")
+        if cold.get("artifact_compile_count") != 0:
+            errs.append("cold_start: artifact_compile_count="
+                        f"{cold.get('artifact_compile_count')!r} — "
+                        "the artifact load path must compile NOTHING; "
+                        "a nonzero count is a compiled start wearing "
+                        "the AOT label")
+        if not isinstance(cold.get("rungs"), int) or cold["rungs"] < 1:
+            errs.append("cold_start: 'rungs' must be a positive int")
+    # the v4 chaos extension: the mid-stream swap actually happened
+    # and every post-swap span carried the new version
+    chaos = art.get("chaos")
+    if isinstance(chaos, dict):
+        if not isinstance(chaos.get("post_swap_requests"), int) \
+                or chaos["post_swap_requests"] < 1:
+            errs.append("chaos: v4 requires a positive "
+                        "'post_swap_requests' (the mid-stream swap "
+                        "must actually precede some requests)")
+        if chaos.get("post_swap_version_ok") is not True:
+            errs.append("chaos: 'post_swap_version_ok' must be true "
+                        "(every post-swap span carries the new "
+                        "model_version)")
     return errs
 
 
