@@ -22,7 +22,7 @@ in the ``bench.py`` schema family with the same strict-backend guard.
 """
 
 from .artifacts import (ArtifactIncompatible, ArtifactManifest,
-                        export_ladder, load_ladder)
+                        export_ladder, load_ladder, prune_artifacts)
 from .batcher import MicroBatcher, coalesce, drain, partition, split_results
 from .chaos import ChaosFault, ChaosPlan, ChaosSpec, resolve_chaos_plan
 from .engine import DEFAULT_BUCKETS, ServingEngine, bucket_for, infer_model
@@ -67,6 +67,7 @@ __all__ = [
     "infer_model",
     "load_ladder",
     "partition",
+    "prune_artifacts",
     "resolve_chaos_plan",
     "split_key",
     "split_results",

@@ -61,6 +61,8 @@ import hashlib
 import json
 import os
 import pickle
+import re
+import shutil
 import threading
 import time
 
@@ -489,6 +491,69 @@ def load_ladder(artifact_dir: str) -> tuple[ArtifactManifest, dict]:
         raise ArtifactIncompatible(
             artifact_dir, [("rungs", sorted(rungs), sorted(want))])
     return manifest, rungs
+
+
+#: Exported-artifact directory names a watcher/CLI writes: the same
+#: ``vNNNN`` family the registry ingests (``registry._VERSION_DIR``) —
+#: one exported ladder per published round boundary.
+_ARTIFACT_DIR = re.compile(r"^v(\d+)$")
+
+
+def prune_artifacts(artifact_dir: str, keep: int,
+                    protect=()) -> list[str]:
+    """Drop the oldest exported ``vNNNN`` artifact directories under
+    ``artifact_dir`` down to ``keep``, never touching a protected
+    entry — the artifact-side twin of ``ModelRegistry.prune`` (same
+    contract: ``keep`` bounds the TOTAL count, protected entries are
+    excluded from deletion even when that leaves more than ``keep``).
+    A continuous publish->export loop otherwise grows one ladder per
+    round boundary forever, each holding every rung twice (StableHLO +
+    native executable).
+
+    ``protect``: version numbers (ints) and/or directory names
+    (``"v0004"``) that must survive — the caller pins the live and
+    candidate versions here, because deleting the artifact a replica
+    is about to cold-start from turns a scale-out into a compile-
+    warmup. Returns the directory names removed (oldest first). A
+    missing ``artifact_dir`` is a normal startup state (nothing was
+    exported yet), not an error."""
+    if keep < 0:
+        raise ValueError(f"keep must be >= 0, got {keep}")
+    if isinstance(protect, (str, int)):
+        # a bare "v0004" would otherwise iterate per CHARACTER and
+        # silently protect nothing — deleting the live artifact a
+        # replica is cold-starting from
+        protect = (protect,)
+    protected_nums: set[int] = set()
+    protected_names: set[str] = set()
+    for p in protect:
+        if isinstance(p, int):
+            protected_nums.add(p)
+        else:
+            name = str(p)
+            protected_names.add(name)
+            m = _ARTIFACT_DIR.match(name)
+            if m:
+                protected_nums.add(int(m.group(1)))
+    try:
+        names = os.listdir(artifact_dir)
+    except OSError:
+        return []
+    entries = []
+    for name in names:
+        m = _ARTIFACT_DIR.match(name)
+        if m and os.path.isdir(os.path.join(artifact_dir, name)):
+            entries.append((int(m.group(1)), name))
+    entries.sort()
+    candidates = [(n, name) for n, name in entries
+                  if n not in protected_nums
+                  and name not in protected_names]
+    removed = []
+    excess = len(entries) - int(keep)
+    for _, name in candidates[:max(0, excess)]:
+        shutil.rmtree(os.path.join(artifact_dir, name))
+        removed.append(name)
+    return removed
 
 
 def load_portable(artifact_dir: str, bucket: int):

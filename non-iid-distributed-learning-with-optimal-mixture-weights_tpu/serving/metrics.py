@@ -102,6 +102,11 @@ class ServeMetrics:
         self.shadow_requests = 0
         self.candidate_errors = 0
         self.rollbacks = 0
+        # failed staleness lookups (the injected staleness_of callable
+        # raising): the dimension degrades to its swap-time value, and
+        # this counter is how an operator learns the LIVE source broke
+        # instead of mistaking a frozen staleness for a healthy one
+        self.staleness_errors = 0
         self._t_first = None
         self._t_last = None
 
@@ -152,6 +157,14 @@ class ServeMetrics:
     def record_rollback(self) -> None:
         with self._lock:
             self.rollbacks += 1
+
+    def record_staleness_error(self) -> None:
+        """One failed staleness lookup (``staleness_of`` or a router's
+        ``staleness_rounds`` raising) absorbed by a staleness-unknown
+        default — counted so a broken registry hookup is visible
+        instead of reading as a permanently-current service."""
+        with self._lock:
+            self.staleness_errors += 1
 
     def record_retry(self) -> None:
         """One transient engine-dispatch failure absorbed by the
@@ -270,5 +283,10 @@ class ServeMetrics:
                 snap["staleness_rounds"] = int(
                     self.staleness_of(snap["model_version"]))
             except Exception:
-                pass  # keep the swap-time value over no value
+                # keep the swap-time value over no value — but COUNT
+                # the broken lookup (GL006: a swallowed failure must
+                # land in telemetry, or a dead registry hookup reads
+                # as a healthy, permanently-current service)
+                self.record_staleness_error()
+        snap["staleness_errors"] = self.staleness_errors
         return snap

@@ -242,7 +242,14 @@ class CheckpointWatcher:
     recorded, but the PUBLISH stands — a registry entry must never be
     withheld because the optional fast-start artifact failed.
     Successful exports are listed in ``artifacts`` as
-    ``(dirname, artifact_path)``. Caveat for cache-enabled hosts: the
+    ``(dirname, artifact_path)``. ``artifact_keep=N`` bounds the export
+    directory like ``ModelRegistry.prune`` bounds the registry: after
+    each export the oldest artifact dirs beyond N are deleted
+    (``artifacts.prune_artifacts``), the just-exported entry always
+    kept and ``artifact_protect()`` (an optional zero-arg callable
+    returning version numbers / dirnames) pinning the live/candidate
+    set a rollout controller is serving; removals land in
+    ``artifacts_pruned``. Caveat for cache-enabled hosts: the
     export briefly toggles the process-global persistent-compile-cache
     flag off (exports serialize under a module lock; a compile on
     another thread inside that window bypasses the cache once), and a
@@ -254,7 +261,8 @@ class CheckpointWatcher:
     def __init__(self, registry: ModelRegistry, watch_dir: str,
                  poll_interval_s: float = 1.0, metadata: dict | None = None,
                  on_publish=None, artifact_dir: str | None = None,
-                 artifact_buckets=None):
+                 artifact_buckets=None, artifact_keep: int | None = None,
+                 artifact_protect=None):
         if poll_interval_s < 0.01:
             raise ValueError(
                 f"poll_interval_s={poll_interval_s} must be >= 0.01 "
@@ -279,6 +287,18 @@ class CheckpointWatcher:
         self.artifact_buckets = (None if artifact_buckets is None
                                  else tuple(int(b)
                                             for b in artifact_buckets))
+        if artifact_keep is not None and int(artifact_keep) < 1:
+            # 0 would delete every export including the one that just
+            # landed — a watcher configured to publish artifacts and
+            # immediately destroy them is a misconfiguration, not a
+            # retention policy
+            raise ValueError(
+                f"artifact_keep={artifact_keep} must be >= 1 (the "
+                "just-exported artifact must survive its own prune)")
+        self.artifact_keep = (None if artifact_keep is None
+                              else int(artifact_keep))
+        self.artifact_protect = artifact_protect
+        self.artifacts_pruned: list[str] = []  # dirnames removed
         self.published: list[tuple[str, int]] = []  # (dirname, version)
         self.artifacts: list[tuple[str, str]] = []  # (dirname, art path)
         self.errors = 0
@@ -290,6 +310,7 @@ class CheckpointWatcher:
         entry in round order. Returns the versions published. Safe to
         call while the daemon runs (polls are serialized)."""
         with self._poll_lock:
+            # graftlint: disable=GL004 serializing whole poll bodies (I/O included) IS this lock's purpose; only the daemon and synchronous test callers contend
             return self._poll_once()
 
     def _poll_once(self) -> list[int]:
@@ -360,6 +381,40 @@ class CheckpointWatcher:
             return
         with self._lock:
             self.artifacts.append((name, out_dir))
+        self._prune_artifacts(name)
+
+    def _prune_artifacts(self, just_exported: str) -> None:
+        """Retention beside the registry's ``prune`` (the PR 9
+        follow-on): after each successful export, drop the oldest
+        artifact dirs down to ``artifact_keep``. The just-exported
+        entry is always protected (a keep=1 watcher holds exactly the
+        newest ladder), plus whatever ``artifact_protect()`` names —
+        the caller's hook for pinning the LIVE and CANDIDATE versions,
+        whose artifacts a cold-starting replica may be mid-download.
+        Failures (a protect callable raising, a racing delete) count
+        into ``errors`` and never unwind the publish/export."""
+        if self.artifact_keep is None:
+            return
+        from .artifacts import prune_artifacts
+
+        try:
+            protect: list = [just_exported]
+            if self.artifact_protect is not None:
+                extra = self.artifact_protect()
+                if isinstance(extra, (str, int)):
+                    # a bare "v0004" must protect ONE name, not
+                    # iterate per character into nothing
+                    extra = (extra,)
+                protect.extend(extra)
+            removed = prune_artifacts(self.artifact_dir,
+                                      self.artifact_keep, protect)
+        except Exception:
+            with self._lock:
+                self.errors += 1
+            return
+        if removed:
+            with self._lock:
+                self.artifacts_pruned.extend(removed)
 
     # -- lifecycle ----------------------------------------------------
     def _run(self) -> None:

@@ -192,6 +192,10 @@ class ServingService:
         try:
             return int(r.staleness_rounds(version))
         except Exception:
+            # a router whose registry lookup breaks must not take the
+            # request span down with it — but the failure is COUNTED
+            # (GL006), not silently read as "current"
+            self.metrics.record_staleness_error()
             return 0
 
     def _trace_request(self, req: _Request, outcome: str, done: float,
@@ -416,6 +420,7 @@ class ServingService:
                 shed = False
                 self._depth += 1
                 depth = self._depth
+                # graftlint: disable=GL004 the queue is UNBOUNDED (depth is bounded here, by _depth) so put never blocks; stop-check+enqueue must stay one atomic region
                 self._q.put(req)
         if shed:
             self.metrics.record_shed("overload")

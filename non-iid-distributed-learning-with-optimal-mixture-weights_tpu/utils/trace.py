@@ -183,14 +183,18 @@ class RotatingJsonlWriter:
                 # paths/spans_written)
                 raise ValueError("RotatingJsonlWriter is closed")
             if self._file is None:
+                # graftlint: disable=GL004 rotation must be atomic with the write it precedes; one writer per tracer, so contention is the emitting thread only
                 self._rotate_locked()
             if self._in_part >= self.max_spans_per_file:
+                # graftlint: disable=GL004 same as above — a racing rotate would double-open part N
                 self._rotate_locked()
+            # graftlint: disable=GL004 serialized per-span write IS this writer's durability contract (measured ~0.96x in the serve bench's paired trace leg)
             self._file.write(line + "\n")
             # flush per span: this mode exists for processes that die
             # without close() (OOM, preemption) and for shippers
             # tailing the live part — buffered tails would lose the
             # last spans and hand readers a truncated JSON line
+            # graftlint: disable=GL004 per-span flush is the crash-durability contract (see comment above)
             self._file.flush()
             self._in_part += 1
             self._written += 1

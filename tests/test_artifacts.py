@@ -24,6 +24,11 @@ Load-bearing guarantees:
   artifact_dir=...)`` exports an artifact beside every published
   vNNNN checkpoint; an export failure counts in ``errors`` without
   unwinding the publish.
+- **Retention** (ISSUE 10 satellite): ``prune_artifacts`` bounds the
+  export directory like ``ModelRegistry.prune`` bounds the registry —
+  oldest first, protected (live/candidate) versions never dropped —
+  and ``CheckpointWatcher(artifact_keep=, artifact_protect=)`` runs it
+  after each export, always keeping the ladder that just landed.
 """
 
 import json
@@ -376,6 +381,90 @@ def test_watcher_publishes_artifacts_beside_checkpoints(tmp_path):
         assert eng.buckets == (1, 4)
         m = ArtifactManifest.load(art_dir)
         assert m.model_version == dict(w.published)[name]
+
+
+def test_prune_artifacts_keeps_protected_and_newest(tmp_path):
+    """Retention beside ModelRegistry.prune (ISSUE 10 satellite):
+    oldest exported dirs drop down to ``keep``, protected versions
+    (ints or dirnames) NEVER drop even when that leaves more than
+    ``keep``, non-vNNNN entries are untouched, and a missing dir is a
+    normal startup state."""
+    from fedamw_tpu.serving import prune_artifacts
+
+    art = tmp_path / "artifacts"
+    for i in range(1, 7):
+        (art / f"v{i:04d}").mkdir(parents=True)
+    (art / "not_a_version").mkdir()
+    removed = prune_artifacts(str(art), keep=3, protect=(2, "v0003"))
+    assert removed == ["v0001", "v0004", "v0005"]  # oldest first
+    assert sorted(os.listdir(art)) == [
+        "not_a_version", "v0002", "v0003", "v0006"]
+    # idempotent at the bound; keep larger than population is a no-op
+    assert prune_artifacts(str(art), keep=3) == []
+    assert prune_artifacts(str(tmp_path / "never_exported"), 1) == []
+    with pytest.raises(ValueError, match="keep must be >= 0"):
+        prune_artifacts(str(art), keep=-1)
+    # a BARE-string protect names one dir, never iterates per char
+    # (protected entries count toward keep, same as ModelRegistry)
+    assert prune_artifacts(str(art), keep=1, protect="v0002") == \
+        ["v0003", "v0006"]
+    assert sorted(os.listdir(art)) == ["not_a_version", "v0002"]
+
+
+def test_watcher_artifact_retention_never_drops_protected(tmp_path):
+    """``CheckpointWatcher(artifact_keep=N)``: each successful export
+    prunes the export dir to N, always keeping the just-exported
+    ladder, plus whatever ``artifact_protect()`` pins (the
+    live/candidate versions a rollout controller is serving)."""
+    watch = tmp_path / "ckpts"
+    art_root = tmp_path / "artifacts"
+    watch.mkdir()
+    for i in (1, 2, 3):
+        _publish_ckpt(watch / f"v{i:04d}", seed=i)
+    reg = ModelRegistry()
+    protected: list = ["v0001"]  # pretend v0001 is still live
+    w = CheckpointWatcher(reg, str(watch), artifact_dir=str(art_root),
+                          artifact_buckets=(1,), artifact_keep=1,
+                          artifact_protect=lambda: tuple(protected))
+    assert w.poll_once() == [1, 2, 3]
+    assert w.errors == 0
+    # keep=1 would hold only the newest, but v0001 is pinned live
+    assert sorted(os.listdir(art_root)) == ["v0001", "v0003"]
+    assert w.artifacts_pruned == ["v0002"]
+    # the pinned artifact still cold-starts its checkpoint
+    eng = ServingEngine.from_artifact(str(art_root / "v0001"),
+                                      checkpoint=str(watch / "v0001"))
+    assert eng.compile_count == 0
+    # a later poll with the pin RELEASED lets v0001 age out
+    protected.clear()
+    _publish_ckpt(watch / "v0004", seed=4)
+    assert w.poll_once() == [4]
+    assert sorted(os.listdir(art_root)) == ["v0004"]
+    assert w.artifacts_pruned == ["v0002", "v0001", "v0003"]
+
+
+def test_watcher_artifact_keep_validations(tmp_path):
+    """keep=0 would delete the export that just landed — refused at
+    construction; a raising protect callable counts in errors and
+    never takes the publish or the export down."""
+    watch = tmp_path / "ckpts"
+    watch.mkdir()
+    _publish_ckpt(watch / "v0001")
+    with pytest.raises(ValueError, match="artifact_keep"):
+        CheckpointWatcher(ModelRegistry(), str(watch),
+                          artifact_dir=str(tmp_path / "a"),
+                          artifact_keep=0)
+
+    def broken_protect():
+        raise RuntimeError("controller gone")
+
+    w = CheckpointWatcher(ModelRegistry(), str(watch),
+                          artifact_dir=str(tmp_path / "a"),
+                          artifact_buckets=(1,), artifact_keep=1,
+                          artifact_protect=broken_protect)
+    assert w.poll_once() == [1]  # publish stands
+    assert [n for n, _ in w.artifacts] == ["v0001"]  # export stands
+    assert w.errors == 1 and w.artifacts_pruned == []
 
 
 def test_watcher_artifact_failure_counts_not_fatal(tmp_path):

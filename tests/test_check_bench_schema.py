@@ -343,3 +343,58 @@ def test_scale_rejects_cohort_drift(tmp_path):
     art["schema"] = "BENCH_SERVE.v3"
     errs = cbs.validate_file(_write(tmp_path, "SCALE_r09.json", art))
     assert any("SCALE. family" in e for e in errs)
+
+
+def _good_graftlint():
+    return {
+        "schema": "GRAFTLINT.v1",
+        "package": "pkg",
+        "rules": {"GL001": {"title": "t", "catches": "c",
+                            "runtime_twin": "r"}},
+        "counts": {"GL001": 0},
+        "findings": [],
+        "baselined": [],
+        "suppressed": [
+            {"rule": "GL003", "path": "serving/engine.py", "line": 9,
+             "message": "m", "context": "c", "fingerprint": "ab12",
+             "reason": "deliberate sync, argued inline"}],
+        "clean": True,
+    }
+
+
+def test_graftlint_artifact_validates_and_rejects_drift(tmp_path):
+    errs = cbs.validate_file(_write(tmp_path, "GRAFTLINT_r01.json",
+                                    _good_graftlint()))
+    assert errs == []
+    # a committed lint artifact carrying findings is the silent-red
+    # landing the gate exists to stop
+    art = _good_graftlint()
+    art["findings"] = [{"rule": "GL001", "path": "x.py", "line": 1,
+                        "message": "m", "fingerprint": "cd34"}]
+    art["clean"] = False
+    errs = cbs.validate_file(_write(tmp_path, "GRAFTLINT_r01.json", art))
+    assert any("must be clean" in e for e in errs)
+    # a suppression without its mandatory reason
+    art = _good_graftlint()
+    art["suppressed"][0].pop("reason")
+    errs = cbs.validate_file(_write(tmp_path, "GRAFTLINT_r01.json", art))
+    assert any("without a reason" in e for e in errs)
+    # a self-contradicting artifact: counts say 7, findings say none
+    art = _good_graftlint()
+    art["counts"] = {"GL001": 7}
+    errs = cbs.validate_file(_write(tmp_path, "GRAFTLINT_r01.json", art))
+    assert any("disagrees with" in e for e in errs)
+    # a partial (--rules) run must not wear a full run's counts table
+    art = _good_graftlint()
+    art["rules_run"] = ["GL001", "GL004"]
+    errs = cbs.validate_file(_write(tmp_path, "GRAFTLINT_r01.json", art))
+    assert any("rules_run" in e for e in errs)
+    # family + version discipline, same as every other artifact
+    art = _good_graftlint()
+    art["schema"] = "SCALE.v1"
+    errs = cbs.validate_file(_write(tmp_path, "GRAFTLINT_r01.json", art))
+    assert any("GRAFTLINT. family" in e for e in errs)
+    art = _good_graftlint()
+    art["schema"] = "GRAFTLINT.v1-rc1"
+    errs = cbs.validate_file(_write(tmp_path, "GRAFTLINT_r01.json", art))
+    assert any("unparseable schema version" in e for e in errs)

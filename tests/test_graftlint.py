@@ -1,0 +1,777 @@
+"""tools/graftlint — the static-analysis gate (ISSUE 10).
+
+Four layers, all tier-1:
+
+- **Per-rule fixtures**: for each of GL001-GL006, a minimal offender
+  that MUST flag and a near-miss that MUST NOT — the rule's contract,
+  pinned as code (a linter whose rules drift silently is worse than
+  none; these are its own regression pins).
+- **Suppression / baseline round-trip**: inline ``# graftlint:
+  disable=`` requires a reason; the baseline file round-trips
+  fingerprints and an EMPTY baseline (what this repo commits) gates
+  every finding.
+- **The repo-wide gate**: the shipped package lints CLEAN — zero
+  unsuppressed findings — so a new trace hazard / lock violation /
+  swallowed exception fails ``pytest -m 'not slow'``.
+- **Mutation checks** (the acceptance criterion): re-introducing a
+  fixed bug or stripping a committed suppression in a copy of the REAL
+  package turns the gate red — proof the gate is live, not
+  vacuously green.
+"""
+
+import json
+import os
+import shutil
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.graftlint import (ALL_RULES, RULES, SCHEMA,  # noqa: E402
+                             default_package_root, run_lint)
+from tools.graftlint.cli import main as cli_main  # noqa: E402
+from tools.graftlint.cli import report_json  # noqa: E402
+from tools.graftlint.suppress import (apply_baseline,  # noqa: E402
+                                      load_baseline, parse_disables,
+                                      save_baseline)
+
+pytestmark = pytest.mark.graftlint
+
+PKG = default_package_root()
+
+
+def lint_src(tmp_path, source, name="mod.py", rules=None):
+    """Lint one snippet as a tiny package; returns (findings,
+    suppressed)."""
+    path = tmp_path / name
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return run_lint(str(tmp_path), rules=rules)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- GL001: trace hazards ---------------------------------------------
+
+def test_gl001_flags_python_if_on_traced_value(tmp_path):
+    findings, _ = lint_src(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """)
+    assert rules_of(findings) == ["GL001"]
+    assert "if" in findings[0].message
+
+
+def test_gl001_flags_concretizers_and_scan_body(tmp_path):
+    findings, _ = lint_src(tmp_path, """
+        import jax
+        import numpy as np
+
+        def run(xs):
+            def body(carry, x):
+                k = float(carry)
+                h = np.asarray(x)
+                j = x.item()
+                return carry, x
+            return jax.lax.scan(body, 0.0, xs)
+    """)
+    msgs = " | ".join(f.message for f in findings)
+    assert rules_of(findings) == ["GL001"]
+    assert len(findings) == 3
+    assert "float(" in msgs and "np.asarray" in msgs and ".item()" in msgs
+
+
+def test_gl001_follows_package_calls_with_traced_args(tmp_path):
+    findings, _ = lint_src(tmp_path, """
+        import jax
+
+        def helper(v):
+            while v > 1:
+                v = v - 1
+            return v
+
+        @jax.jit
+        def f(x):
+            return helper(x)
+    """)
+    assert rules_of(findings) == ["GL001"]
+    assert "while" in findings[0].message
+
+
+def test_gl001_near_misses_stay_silent(tmp_path):
+    # is-None tests, static attrs (.shape/.ndim), len(), static
+    # argnames, and branching on a helper's TRACE-TIME-STATIC return
+    # are all how shape-stable jax code is supposed to look
+    findings, _ = lint_src(tmp_path, """
+        import jax
+        from functools import partial
+
+        def resolve(params, forced):
+            if forced:
+                return "pallas"
+            return "xla"
+
+        @partial(jax.jit, static_argnames=("mode",))
+        def f(x, mode, y=None):
+            if mode == "fast":
+                x = x * 2
+            if y is not None:
+                x = x + y
+            if x.ndim == 2 and x.shape[0] > 4:
+                x = x[:4]
+            if len(x) > 2:
+                x = x * 1.0
+            impl = resolve(x, False)
+            if impl.startswith("pallas"):
+                x = x + 1
+            return x
+    """)
+    assert findings == []
+
+
+# -- GL002: recompile hazards in hot paths ----------------------------
+
+def test_gl002_flags_fresh_jit_in_hot_path(tmp_path):
+    findings, _ = lint_src(tmp_path, """
+        import jax
+
+        class ServingEngine:
+            def predict(self, X):
+                fn = jax.jit(lambda v: v * 2)
+                return fn(X)
+    """, name="serving/engine.py")
+    assert "GL002" in rules_of(findings)
+    assert "fresh `jax.jit`" in findings[0].message
+
+
+def test_gl002_flags_shape_keyed_cache_in_hot_path(tmp_path):
+    findings, _ = lint_src(tmp_path, """
+        class ServingEngine:
+            def _run(self, X):
+                self._cache[X.shape] = 1
+                self._seen.add(X.dtype)
+                return X
+    """, name="serving/engine.py")
+    assert rules_of(findings) == ["GL002"]
+    assert len(findings) == 2
+
+
+def test_gl002_near_misses_stay_silent(tmp_path):
+    # jit at construction time, and shapes in ERROR MESSAGES (raise
+    # paths are not hot), are the blessed patterns
+    findings, _ = lint_src(tmp_path, """
+        import jax
+
+        class ServingEngine:
+            def __init__(self):
+                self._predict = jax.jit(lambda v: v)
+
+            def predict(self, X):
+                if X.ndim != 2:
+                    raise ValueError(f"bad shape {X.shape}")
+                return self._predict(X)
+    """, name="serving/engine.py")
+    assert findings == []
+
+
+# -- GL003: host sync in hot paths ------------------------------------
+
+def test_gl003_flags_device_sync_in_hot_path(tmp_path):
+    findings, _ = lint_src(tmp_path, """
+        import numpy as np
+
+        class ServingEngine:
+            def _run(self, X):
+                out = self._predict(X)
+                out.block_until_ready()
+                return np.asarray(out)
+    """, name="serving/engine.py")
+    assert rules_of(findings) == ["GL003"]
+    assert len(findings) == 2
+
+
+def test_gl003_near_misses_stay_silent(tmp_path):
+    # converting the INPUT (host->host) is fine; so is converting a
+    # dispatch result outside the hot-path set
+    findings, _ = lint_src(tmp_path, """
+        import numpy as np
+
+        class ServingEngine:
+            def _run(self, X):
+                X = np.asarray(X, dtype=np.float32)
+                return self._predict(X)
+
+            def debug_dump(self, X):
+                out = self._predict(X)
+                return np.asarray(out)
+    """, name="serving/engine.py")
+    assert findings == []
+
+
+# -- GL004: lock discipline -------------------------------------------
+
+def test_gl004_flags_blocking_under_lock(tmp_path):
+    findings, _ = lint_src(tmp_path, """
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow(self):
+                with self._lock:
+                    time.sleep(0.1)
+
+            def io(self):
+                with self._lock:
+                    with open("/tmp/x") as f:
+                        return f.read()
+    """)
+    assert rules_of(findings) == ["GL004"]
+    assert len(findings) >= 2
+
+
+def test_gl004_flags_blocking_through_local_call(tmp_path):
+    findings, _ = lint_src(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _write_out(self):
+                with open("/tmp/x", "w") as f:
+                    f.write("hi")
+
+            def publish(self):
+                with self._lock:
+                    self._write_out()
+    """)
+    assert rules_of(findings) == ["GL004"]
+    assert any("_write_out" in f.message for f in findings)
+
+
+def test_gl004_flags_nonreentrant_reacquire_not_rlock(tmp_path):
+    findings, _ = lint_src(tmp_path, """
+        import threading
+
+        class Bad:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+
+        class Fine:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def f(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """)
+    assert rules_of(findings) == ["GL004"]
+    assert len(findings) == 1
+    assert "not reentrant" in findings[0].message
+
+
+def test_gl004_near_misses_stay_silent(tmp_path):
+    # blocking OUTSIDE the lock, and pure state flips under it, are
+    # exactly the pattern the serving stack uses
+    findings, _ = lint_src(tmp_path, """
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    x = 1
+                time.sleep(0.01)
+                return x
+    """)
+    assert findings == []
+
+
+# -- GL005: impure traced code ----------------------------------------
+
+def test_gl005_flags_host_rng_and_wallclock_in_traced_code(tmp_path):
+    findings, _ = lint_src(tmp_path, """
+        import time
+
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            noise = np.random.randn(4)
+            t0 = time.time()
+            return x + noise.sum() + t0
+    """)
+    assert rules_of(findings) == ["GL005"]
+    assert len(findings) == 2
+
+
+def test_gl005_near_misses_stay_silent(tmp_path):
+    # jax.random with a threaded key IS the blessed randomness, and
+    # host rng/clocks outside traced scope are ordinary host code
+    findings, _ = lint_src(tmp_path, """
+        import time
+
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x, key):
+            return x + jax.random.normal(key, x.shape)
+
+        def host_driver():
+            seed = np.random.randint(0, 2 ** 31)
+            return seed, time.time()
+    """)
+    assert findings == []
+
+
+# -- GL006: exception hygiene on serving threads ----------------------
+
+def test_gl006_flags_swallowing_handler_in_serving_module(tmp_path):
+    findings, _ = lint_src(tmp_path, """
+        class Worker:
+            def _loop(self):
+                try:
+                    self.step()
+                except Exception:
+                    pass
+    """, name="serving/loop.py")
+    assert rules_of(findings) == ["GL006"]
+
+
+def test_gl006_flags_bare_except_in_thread_target(tmp_path):
+    # thread targets OUTSIDE serving/ are in scope too (the watcher
+    # pattern); resolution follows Thread(target=self._run)
+    findings, _ = lint_src(tmp_path, """
+        import threading
+
+        class W:
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                try:
+                    self.poll()
+                except:
+                    return
+    """)
+    assert rules_of(findings) == ["GL006"]
+    assert "bare" in findings[0].message
+
+
+def test_gl006_accounted_handlers_stay_silent(tmp_path):
+    # typed excepts, counted failures, forwarded exceptions, and
+    # re-raises are the four blessed shapes (service/_poll_once/
+    # replica requeue all use one of them)
+    findings, _ = lint_src(tmp_path, """
+        class Worker:
+            def _loop(self):
+                try:
+                    self.step()
+                except ValueError:
+                    pass
+
+            def _poll(self):
+                try:
+                    self.step()
+                except Exception:
+                    self.errors += 1
+
+            def _serve(self, fut):
+                try:
+                    self.step()
+                except Exception as e:
+                    fut.set_exception(e)
+
+            def _guard(self):
+                try:
+                    self.step()
+                except Exception:
+                    self.metrics.record_rollback()
+                    raise
+    """, name="serving/loop.py")
+    assert findings == []
+
+
+# -- resolution edge cases (review pins) ------------------------------
+
+def test_relative_import_in_package_init_resolves(tmp_path):
+    """``from .impl import helper`` inside ``sub/__init__.py`` must
+    land on ``sub/impl.py`` (the containing package, not one level
+    up) — trace propagation through package re-export modules depends
+    on it."""
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "impl.py").write_text(textwrap.dedent("""
+        def helper(v):
+            if v > 0:
+                return v
+            return -v
+    """))
+    (tmp_path / "sub" / "__init__.py").write_text(textwrap.dedent("""
+        import jax
+
+        from .impl import helper
+
+        @jax.jit
+        def traced(x):
+            return helper(x)
+    """))
+    findings, _ = run_lint(str(tmp_path))
+    assert [f.rule for f in findings] == ["GL001"]
+    assert findings[0].path == "sub/impl.py"
+
+
+def test_builtin_map_is_not_a_trace_entry(tmp_path):
+    """Plain builtin ``map``/``filter`` must not classify as
+    ``jax.lax.map`` and mint false traced roots."""
+    findings, _ = lint_src(tmp_path, """
+        def pick(x):
+            if x:
+                return 1
+            return 0
+
+        def host_code(xs):
+            return list(map(pick, xs))
+    """)
+    assert findings == []
+
+
+def test_identical_context_findings_get_distinct_fingerprints(
+        tmp_path):
+    """Two textually identical violations in one file must carry
+    distinct fingerprints — one baseline entry must not silence
+    both sites."""
+    findings, _ = lint_src(tmp_path, """
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def a(self):
+                with self._lock:
+                    time.sleep(0.1)
+
+            def b(self):
+                with self._lock:
+                    time.sleep(0.1)
+    """)
+    assert len(findings) == 2
+    assert findings[0].context == findings[1].context
+    assert findings[0].fingerprint != findings[1].fingerprint
+
+
+def test_cli_missing_or_empty_root_fails_loudly(tmp_path, capsys):
+    """A typo'd path must never report clean (exit 2, 'no Python
+    modules') — the silent-green landing the review caught."""
+    rc = cli_main([str(tmp_path / "no_such_dir")])
+    assert rc == 2
+    assert "no Python modules" in capsys.readouterr().err
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert cli_main([str(empty)]) == 2
+
+
+# -- suppression / baseline round-trip --------------------------------
+
+def test_suppression_requires_reason(tmp_path):
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:  # graftlint: disable=GL001 {reason}
+                return x
+            return -x
+    """
+    findings, suppressed = lint_src(tmp_path, src.format(
+        reason="trace-time constant branch, proven by pin X"))
+    assert findings == [] and len(suppressed) == 1
+    assert suppressed[0].reason.startswith("trace-time constant")
+
+    findings, suppressed = lint_src(tmp_path / "two",
+                                    src.format(reason=""))
+    # reasonless: does NOT suppress, and says so
+    assert suppressed == [] and len(findings) == 1
+    assert "no reason given" in findings[0].message
+
+
+def test_suppression_line_above_and_wrong_rule(tmp_path):
+    findings, suppressed = lint_src(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(x):
+            # graftlint: disable=GL001 constant branch by contract
+            if x > 0:
+                return x
+            # graftlint: disable=GL005 wrong rule id does not suppress
+            if x > 1:
+                return x
+            return -x
+    """)
+    assert len(suppressed) == 1 and suppressed[0].rule == "GL001"
+    assert len(findings) == 1 and findings[0].rule == "GL001"
+
+
+def test_parse_disables_grammar():
+    assert parse_disables("x  # graftlint: disable=GL001 why") == \
+        (("GL001",), "why")
+    assert parse_disables(
+        "x  # graftlint: disable=GL001,GL004 two rules") == \
+        (("GL001", "GL004"), "two rules")
+    assert parse_disables("x  # a normal comment") is None
+
+
+def test_baseline_round_trip(tmp_path):
+    findings, _ = lint_src(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """)
+    assert len(findings) == 1
+    bl_path = str(tmp_path / "baseline.json")
+    save_baseline(findings, bl_path)
+    fps = load_baseline(bl_path)
+    new, old = apply_baseline(findings, fps)
+    assert new == [] and len(old) == 1  # baselined: reported, not fatal
+    new, old = apply_baseline(findings, set())  # the committed shape
+    assert len(new) == 1 and old == []
+    # fingerprints are line-number-free: an edit ABOVE the finding
+    # must not orphan the baseline entry
+    assert findings[0].fingerprint == \
+        findings[0].__class__(rule=findings[0].rule,
+                              path=findings[0].path, line=999,
+                              message="other",
+                              context=findings[0].context).fingerprint
+
+
+def test_malformed_baseline_raises(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({"not_fingerprints": []}))
+    with pytest.raises(ValueError, match="malformed baseline"):
+        load_baseline(str(p))
+
+
+def test_committed_baseline_is_empty():
+    # the adoption escape hatch stays closed in THIS repo: every
+    # pre-existing finding was fixed or argued inline, so the gate
+    # runs at full strength
+    assert load_baseline() == set()
+
+
+# -- CLI + JSON schema ------------------------------------------------
+
+def test_cli_json_clean_run_and_schema_gate(tmp_path, capsys):
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    rc = cli_main([str(tmp_path), "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["schema"] == SCHEMA == "GRAFTLINT.v1"
+    assert out["clean"] is True and out["findings"] == []
+    assert set(out["counts"]) == set(ALL_RULES)
+    assert out["rules_run"] == sorted(ALL_RULES)
+    assert set(out["rules"]) == set(RULES)
+    # a --rules subset is honest about its coverage: the counts table
+    # covers exactly what ran, and rules_run records it
+    rc = cli_main([str(tmp_path), "--rules", "GL004", "--format",
+                   "json"])
+    sub = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert sub["rules_run"] == ["GL004"]
+    assert set(sub["counts"]) == {"GL004"}
+    # the check_bench_schema gate accepts what graftlint emits
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import check_bench_schema as cbs
+
+    art = tmp_path / "GRAFTLINT_selftest.json"
+    art.write_text(json.dumps(out))
+    assert cbs.validate_file(str(art)) == []
+
+
+def test_cli_text_failing_run_and_dirty_artifact_rejected(
+        tmp_path, capsys):
+    (tmp_path / "bad.py").write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """))
+    rc = cli_main([str(tmp_path)])
+    text = capsys.readouterr()
+    assert rc == 1
+    assert "GL001" in text.out and "bad.py" in text.out
+    rc = cli_main([str(tmp_path), "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["clean"] is False
+    # a DIRTY artifact must never land committed: the schema gate
+    # re-rejects it even though it is structurally valid JSON
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import check_bench_schema as cbs
+
+    art = tmp_path / "GRAFTLINT_dirty.json"
+    art.write_text(json.dumps(out))
+    assert any("must be clean" in e for e in cbs.validate_file(
+        str(art)))
+
+
+def test_cli_unknown_rule_is_an_error(tmp_path):
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    assert cli_main([str(tmp_path), "--rules", "GL999"]) == 2
+
+
+# -- the repo-wide tier-1 gate ----------------------------------------
+
+def test_package_gate_zero_unsuppressed_findings():
+    """THE gate: the shipped package lints clean. A new traced-branch,
+    hot-path sync, lock violation, or swallowed exception anywhere in
+    the package fails tier-1 right here."""
+    findings, suppressed = run_lint(PKG)
+    assert findings == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings)
+    # every committed suppression is an ARGUED one
+    assert all(f.reason for f in suppressed)
+    # and the suppression set is the audited one — a new suppression
+    # is a reviewed decision, not a drive-by (update this count with
+    # the justification in the diff)
+    assert len(suppressed) == 8
+
+
+# -- mutation checks: the gate is live --------------------------------
+
+@pytest.fixture()
+def pkg_copy(tmp_path):
+    dst = tmp_path / "pkg"
+    shutil.copytree(PKG, dst, ignore=shutil.ignore_patterns(
+        "__pycache__", "*.pyc"))
+    return dst
+
+
+def _edit(path, old, new):
+    text = path.read_text()
+    assert old in text, f"mutation anchor missing in {path.name}"
+    path.write_text(text.replace(old, new, 1))
+
+
+def test_mutation_stripped_suppressions_refire(pkg_copy):
+    """Deleting the committed inline disables re-fires their rules —
+    the suppressions are load-bearing, not decorative."""
+    for rel, rule in (("serving/engine.py", "GL002"),
+                      ("serving/engine.py", "GL003"),
+                      ("serving/registry.py", "GL004"),
+                      ("utils/trace.py", "GL004")):
+        path = pkg_copy / rel
+        text = path.read_text()
+        lines = [ln for ln in text.splitlines()
+                 if f"graftlint: disable={rule}" not in ln]
+        assert len(lines) < len(text.splitlines())
+        path.write_text("\n".join(lines) + "\n")
+    findings, _ = run_lint(str(pkg_copy))
+    fired = rules_of(findings)
+    assert "GL002" in fired and "GL003" in fired and "GL004" in fired
+
+
+def test_mutation_reverted_gl006_fixes_refire(pkg_copy):
+    """Re-introducing the swallowed-exception bugs this PR fixed turns
+    the gate red again."""
+    _edit(pkg_copy / "serving" / "service.py",
+          "self.metrics.record_staleness_error()\n            return 0",
+          "return 0")
+    _edit(pkg_copy / "serving" / "metrics.py",
+          "self.record_staleness_error()",
+          "pass")
+    findings, _ = run_lint(str(pkg_copy), rules=("GL006",))
+    paths = {f.path for f in findings}
+    assert paths == {"serving/service.py", "serving/metrics.py"}
+
+
+def test_mutation_injected_hazards_fail_the_gate(pkg_copy):
+    """One injected offender per rule, dropped into the real package
+    tree, turns the gate red with exactly that rule — GL001-GL006 are
+    each proven live against the shipped code, not just toy fixtures."""
+    (pkg_copy / "fedcore" / "_gl_mutation.py").write_text(textwrap.dedent("""
+        import time
+
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def _mut_gl001(x):
+            if x > 0:
+                return x
+            return -x
+
+        @jax.jit
+        def _mut_gl005(x):
+            return x + np.random.randn(4).sum() + time.time()
+    """))
+    _edit(pkg_copy / "serving" / "engine.py",
+          "        weights = self._resolve(version)",
+          "        _fresh = jax.jit(lambda v: v)  # injected GL002\n"
+          "        weights = self._resolve(version)")
+    (pkg_copy / "serving" / "_gl_mutation.py").write_text(
+        textwrap.dedent("""
+        import threading
+        import time
+
+        import numpy as np
+
+
+        class _MutHot:
+            def _work(self):
+                try:
+                    self.step()
+                except Exception:
+                    pass
+
+            def _locked(self):
+                with self._lock:
+                    time.sleep(0.5)
+        """))
+    findings, _ = run_lint(str(pkg_copy))
+    fired = rules_of(findings)
+    for rule in ("GL001", "GL002", "GL004", "GL005", "GL006"):
+        assert rule in fired, f"{rule} did not fire on its mutation"
+
+
+def test_mutation_gl003_sync_in_real_hot_path(pkg_copy):
+    """A block_until_ready dropped into the REAL ServingEngine._run
+    dispatch fails the gate as GL003."""
+    _edit(pkg_copy / "serving" / "engine.py",
+          "            out = self._predict(x, params, rff)",
+          "            out = self._predict(x, params, rff)\n"
+          "            out.block_until_ready()")
+    findings, _ = run_lint(str(pkg_copy), rules=("GL003",))
+    assert [f.rule for f in findings] == ["GL003"]
+    assert findings[0].path == "serving/engine.py"

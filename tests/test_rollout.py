@@ -479,6 +479,58 @@ def test_registry_seeded_engine_reports_staleness_before_any_swap(
     assert snap["staleness_rounds"] == 7  # behind, with zero swaps
 
 
+def test_snapshot_counts_broken_staleness_lookup():
+    """GL006 regression (graftlint): a raising ``staleness_of`` keeps
+    degrading to the swap-time value — but the failure is COUNTED
+    (``staleness_errors``), never silently swallowed; a dead registry
+    hookup must not read as a permanently-current service."""
+    from fedamw_tpu.serving import ServeMetrics
+
+    m = ServeMetrics()
+    m.record_swap(version=3, staleness_rounds=2)
+
+    def broken(_version):
+        raise KeyError("registry lost the version")
+
+    m.staleness_of = broken
+    snap = m.snapshot()
+    assert snap["staleness_rounds"] == 2  # swap-time value survives
+    assert snap["staleness_errors"] == 1
+    assert m.snapshot()["staleness_errors"] == 2  # counts per lookup
+    m.staleness_of = lambda v: 9  # recovered source wins again
+    snap = m.snapshot()
+    assert snap["staleness_rounds"] == 9
+    assert snap["staleness_errors"] == 2  # no new error
+
+
+def test_span_staleness_counts_broken_router_lookup():
+    """GL006 regression (graftlint): a router whose
+    ``staleness_rounds`` raises must not take the request span down —
+    the span reports staleness 0 and the failure lands in
+    ``staleness_errors``."""
+    engine = make_engine()
+    rng = np.random.RandomState(13)
+    X = rng.randn(4, D).astype(np.float32)
+    tracer = Tracer(enabled=True)
+
+    class _BrokenRouter:
+        def split(self):
+            return None
+
+        def staleness_rounds(self, version):
+            raise RuntimeError("registry connection lost")
+
+    with ServingService(engine, max_wait_ms=0.5, tracer=tracer) as svc:
+        svc.router = _BrokenRouter()
+        out = svc.predict(X)
+    assert out.shape == (4, C)
+    spans = [r for r in tracer.records() if r["kind"] == "span"
+             and r["name"] == "request"]
+    assert len(spans) == 1  # the span still landed
+    assert spans[0]["attrs"]["staleness_rounds"] == 0
+    assert svc.metrics.staleness_errors >= 1
+
+
 def test_second_concurrent_stage_is_refused():
     engine = make_engine()
     reg = ModelRegistry()

@@ -1,0 +1,4 @@
+# Repo tooling namespace (check_bench_schema, export_artifacts,
+# graftlint). Kept a package so `python -m tools.graftlint` works from
+# the repo root; the standalone `python tools/<script>.py` spellings are
+# unchanged.

@@ -42,6 +42,14 @@ Three artifact families, three rule sets:
 - ``MULTICHIP_rNN.json`` — the dryrun wrapper: ``n_devices``/``rc``/
   ``ok``/``tail``, with ``ok`` true iff ``rc == 0`` (a disagreeing
   pair is exactly the silent-green failure this tool exists to catch).
+- ``GRAFTLINT_rNN.json`` — ``python -m tools.graftlint --format json``
+  output (the ISSUE 10 static-analysis gate): ``schema`` in the
+  ``GRAFTLINT.`` family, the per-rule ``counts`` table covering every
+  GL rule, ``findings`` EMPTY with ``clean`` true (a committed lint
+  artifact carrying findings is the silent-red landing this gate
+  exists to stop), and every ``suppressed`` entry carrying its
+  mandatory reason — the audit trail that makes an inline disable an
+  argued exception instead of a silence.
 - ``SCALE_rNN.json`` — ``scale_bench.py``'s own artifact (the ISSUE 8
   cohort plane): ``schema`` in the ``SCALE.`` family, a ``platform``
   label, a non-empty ``records`` list, and — from schema v1 on — a
@@ -68,7 +76,8 @@ import sys
 
 #: Filename prefix -> validator. Order matters: BENCH_SERVE_ must be
 #: tested before the BENCH_ prefix it also matches.
-FAMILIES = ("BENCH_SERVE_", "BENCH_", "MULTICHIP_", "SCALE_")
+FAMILIES = ("BENCH_SERVE_", "BENCH_", "MULTICHIP_", "SCALE_",
+            "GRAFTLINT_")
 
 
 def _tail_json_lines(tail: str) -> list[dict]:
@@ -390,11 +399,80 @@ def check_scale_artifact(art: dict, name: str) -> list[str]:
     return errs
 
 
+def check_graftlint_artifact(art: dict, name: str) -> list[str]:
+    """``tools.graftlint --format json`` output (GRAFTLINT.vN)."""
+    errs = []
+    schema = str(art.get("schema", ""))
+    if not schema.startswith("GRAFTLINT."):
+        errs.append(f"schema must be in the GRAFTLINT. family, "
+                    f"got {art.get('schema')!r}")
+        return errs
+    try:
+        int(schema.rsplit(".v", 1)[1])
+    except (IndexError, ValueError):
+        errs.append(f"unparseable schema version {schema!r} "
+                    "(expected GRAFTLINT.vN)")
+    counts = art.get("counts")
+    if not isinstance(counts, dict) or not counts:
+        errs.append("'counts' must be the per-rule finding table")
+    else:
+        for rule, n in counts.items():
+            if not isinstance(n, int) or n < 0:
+                errs.append(f"counts[{rule}]: must be a non-negative "
+                            "int")
+    findings = art.get("findings")
+    if isinstance(counts, dict) and isinstance(findings, list) and \
+            sum(n for n in counts.values()
+                if isinstance(n, int)) != len(findings):
+        # a self-contradicting artifact (counts say 7, findings say
+        # none) must not validate — the table and the list are two
+        # views of ONE result
+        errs.append(f"counts total {sum(counts.values())!r} "
+                    f"disagrees with {len(findings)} finding(s)")
+    rules_run = art.get("rules_run")
+    if rules_run is not None:
+        if not isinstance(rules_run, list) or not rules_run:
+            errs.append("'rules_run' must be a non-empty list of the "
+                        "rules this run executed")
+        elif isinstance(counts, dict) and \
+                set(counts) != set(map(str, rules_run)):
+            # a partial (--rules) run must not wear a full run's
+            # counts table
+            errs.append("counts keys disagree with 'rules_run' — a "
+                        "partial run must not read as full coverage")
+    if not isinstance(findings, list):
+        errs.append("'findings' must be a list")
+    elif findings or art.get("clean") is not True:
+        # the committed-artifact contract: a lint artifact may only
+        # land CLEAN — findings belong in the PR that fixes them, not
+        # in a green-looking JSON nobody reads
+        errs.append(f"{len(findings or [])} finding(s) with "
+                    f"clean={art.get('clean')!r} — a committed "
+                    "graftlint artifact must be clean")
+    for section in ("suppressed", "baselined"):
+        entries = art.get(section)
+        if not isinstance(entries, list):
+            errs.append(f"'{section}' must be a list")
+            continue
+        for i, rec in enumerate(entries):
+            if not isinstance(rec, dict) or not all(
+                    k in rec for k in ("rule", "path", "line",
+                                       "fingerprint")):
+                errs.append(f"{section}[{i}]: missing "
+                            "rule/path/line/fingerprint")
+            elif section == "suppressed" and not rec.get("reason"):
+                errs.append(f"{section}[{i}]: suppression without a "
+                            "reason (the inline-disable contract "
+                            "requires one)")
+    return errs
+
+
 CHECKERS = {
     "BENCH_SERVE_": check_serve_artifact,
     "BENCH_": check_bench_wrapper,
     "MULTICHIP_": check_multichip,
     "SCALE_": check_scale_artifact,
+    "GRAFTLINT_": check_graftlint_artifact,
 }
 
 

@@ -1,0 +1,180 @@
+"""graftlint: repo-native static analysis for the jax_graft invariants.
+
+Every load-bearing guarantee in this codebase is enforced at runtime by
+pins that execute ONE path: ``compile_count`` stays flat across fault
+plans and weight swaps (``tests/test_serve_contract.py``,
+``tests/test_faults.py``), swaps are atomic under concurrent submit
+(``tests/test_rollout.py``), every accepted request lands exactly one
+span (``tests/test_trace.py``). A new ``if`` on a traced value, a
+``.item()`` inside a jit scope, or a lock held across an engine dispatch
+ships silently until a bench regresses. graftlint proves the same
+invariants at the AST, over every file, on every PR — the static twin
+of the runtime pins.
+
+Rules (stable IDs; each names the runtime pin it twins):
+
+=======  ==============================================================
+GL001    trace hazards: Python ``if``/``while``/``bool``/``int``/
+         ``float``/``.item()``/``np.asarray`` on values flowing from
+         jit/scan/vmap-scoped arguments. Twin of the ConcretizationError
+         the fused round scan would raise — but only on the path a test
+         happens to trace.
+GL002    recompile hazards: fresh ``jax.jit`` construction, or array
+         ``.shape``/``.dtype`` interpolated into cache keys, inside
+         serving hot paths. Twin of the ``compile_count`` pins in
+         tests/test_serve_contract.py and tests/test_faults.py.
+GL003    host sync in serving hot paths: ``block_until_ready`` or
+         implicit device->numpy conversion inside engine dispatch /
+         ``_serve_batch`` / replica routing. Twin of the serve bench's
+         stage-split latency accounting.
+GL004    lock discipline: a ``threading.Lock`` held across a blocking
+         call (engine dispatch, ``queue.get``, file I/O, ``sleep``) or
+         re-acquired non-reentrantly. Twin of the swap-atomicity and
+         exactly-once-span pins.
+GL005    unseeded randomness / wall-clock reads inside traced code:
+         ``np.random``/``random``/``time.time`` under jit bake one
+         trace-time constant into every execution. Twin of the
+         seeded-determinism pins in tests/test_faults.py.
+GL006    exception hygiene in serving worker threads: a bare/overbroad
+         ``except`` that neither counts into ``ServeMetrics``-style
+         telemetry, re-raises, nor propagates the caught exception.
+         Twin of the zero-lost-requests chaos pin.
+=======  ==============================================================
+
+Findings are suppressible ONLY inline::
+
+    risky_line()  # graftlint: disable=GL003 <reason, mandatory>
+
+(a reasonless disable does not suppress), plus a committed baseline
+(``tools/graftlint/baseline.json`` — kept EMPTY: every pre-existing true
+finding in the package is fixed or inline-suppressed with a reason, and
+the tier-1 gate ``tests/test_graftlint.py`` holds it at zero).
+
+Run: ``python -m tools.graftlint [--format json]`` — JSON output carries
+the versioned ``GRAFTLINT.v1`` schema, gated by
+``tools/check_bench_schema.py`` like every other machine-read artifact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+#: JSON output schema tag. Bump on any field-semantics change —
+#: tools/check_bench_schema.py refuses unknown majors the same way it
+#: does for BENCH_SERVE.vN artifacts.
+SCHEMA = "GRAFTLINT.v1"
+
+#: Rule ID -> (title, what it catches, the runtime pin it twins).
+RULES = {
+    "GL001": (
+        "trace hazard",
+        "Python control flow or concretization (if/while/bool/int/"
+        "float/.item()/np.asarray) on a value that flows from "
+        "jit/scan/vmap-scoped arguments",
+        "zero-recompile scan sweep (tests/test_faults.py); "
+        "ConcretizationError at trace time"),
+    "GL002": (
+        "recompile hazard",
+        "fresh jax.jit construction, or array .shape/.dtype used as a "
+        "cache/dispatch key, inside a serving hot path",
+        "compile_count pins (tests/test_serve_contract.py, "
+        "tests/test_faults.py)"),
+    "GL003": (
+        "host sync in hot path",
+        "block_until_ready or device->numpy conversion inside engine "
+        "dispatch / _serve_batch / replica routing",
+        "serve bench stage split + latency percentiles "
+        "(tests/test_serve_contract.py)"),
+    "GL004": (
+        "lock discipline",
+        "threading lock held across a blocking call (engine dispatch, "
+        "queue.get, file I/O, sleep, join) or re-acquired "
+        "non-reentrantly",
+        "swap-atomicity / exactly-once-span pins "
+        "(tests/test_rollout.py, tests/test_replica.py)"),
+    "GL005": (
+        "impure traced code",
+        "unseeded randomness (np.random/random) or wall-clock reads "
+        "(time.time/perf_counter/datetime.now) inside traced code — "
+        "baked to a trace-time constant",
+        "seeded fault-plan determinism (tests/test_faults.py, "
+        "tests/test_replica.py)"),
+    "GL006": (
+        "exception hygiene",
+        "bare/overbroad except in serving-thread code that neither "
+        "counts into metrics, re-raises, nor propagates the caught "
+        "exception",
+        "zero lost requests under chaos (tests/test_replica.py); "
+        "every future resolves (tests/test_serving.py)"),
+}
+
+ALL_RULES = tuple(sorted(RULES))
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a source line."""
+
+    rule: str
+    path: str       # package-relative posix path
+    line: int       # 1-indexed
+    message: str
+    context: str = ""   # stripped source line (operator orientation)
+    suppressed: bool = False
+    reason: str = ""    # suppression reason when suppressed
+    occurrence: int = 0  # index among same-file findings with
+    # identical context (two `self._rotate_locked()` sites must not
+    # share a baseline fingerprint — one entry would silence both)
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable identity for baseline matching: rule + file +
+        normalized source text + occurrence index (NOT the line
+        number, so findings survive unrelated edits above them — but
+        textually identical violations in one file stay distinct)."""
+        blob = (f"{self.rule}|{self.path}|{self.context.strip()}"
+                f"|{self.occurrence}")
+        return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "context": self.context,
+            "fingerprint": self.fingerprint,
+            **({"reason": self.reason} if self.suppressed else {}),
+        }
+
+
+def default_package_root() -> str:
+    """The shipped package directory this repo lints tier-1 — the
+    checkout path when run from the repo, else the INSTALLED
+    ``fedamw_tpu`` package (the `graftlint` console script outside a
+    checkout). A miss on both falls through to the CLI's loud
+    missing-root error, never a silent clean."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    path = os.path.join(
+        repo,
+        "non-iid-distributed-learning-with-optimal-mixture-weights_tpu")
+    if os.path.isdir(path):
+        return path
+    try:
+        import fedamw_tpu
+
+        return os.path.dirname(os.path.abspath(fedamw_tpu.__file__))
+    except ImportError:
+        return path
+
+
+def run_lint(root: str | None = None, rules=None):
+    """Lint one package tree; returns ``(findings, suppressed)`` —
+    the programmatic surface the tier-1 gate and the CLI share."""
+    from .rules import lint_package
+
+    return lint_package(root or default_package_root(), rules=rules)

@@ -1,0 +1,748 @@
+"""AST walker + scope inference for graftlint.
+
+Three inference layers, all deliberately conservative (a finding must be
+worth a human's attention — when resolution fails, graftlint stays
+silent rather than guessing):
+
+**Traced scopes** (GL001/GL005): a function is traced when it is
+jit-decorated (``@jax.jit``, ``@partial(jax.jit, static_argnames=...)``),
+passed to ``jax.jit``/``jax.vmap``/``jax.lax.scan|cond|while_loop|
+fori_loop|switch|map``/``jax.checkpoint`` as a function argument, or
+called FROM a traced scope with at least one traced argument — the
+"functions they call within the package" closure, resolved through
+same-module defs, nested defs, and package-relative imports. Within a
+traced function, tracedness flows forward through assignments: a name is
+traced when it derives from a traced parameter (jit ``static_argnums``/
+``static_argnames`` excluded — those are Python values by contract).
+Static extractors (``.shape``/``.ndim``/``.dtype``/``.size``, ``len``)
+yield Python values under trace and break the flow; ``is``/``is not``
+comparisons are structural (trace-time static) and never hazards. Host
+escapes (``jax.debug.callback``/``jax.pure_callback``/``io_callback``/
+``jax.debug.print``) do NOT propagate trace scope — their targets run on
+the host by construction.
+
+**Hot paths** (GL002/GL003): the serving dispatch surface, named
+explicitly in :data:`HOT_PATHS` — the functions whose latency IS the
+serve bench's p50/p95/p99. Device-flow inside them: a name assigned from
+a ``predict``/``_predict`` call holds device buffers; converting it
+(np.asarray/np.array/float/.item) blocks the worker thread.
+
+**Thread scopes** (GL006): functions passed as ``threading.Thread(
+target=...)`` or ``pool.submit(...)`` targets anywhere in the package,
+plus every function defined in ``serving/`` (the whole module family
+runs under the service's worker/watcher/hedge threads).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+#: Serving hot paths: module-relative posix path -> dotted qualnames.
+#: The GL002/GL003 scope — extend when a new dispatch surface lands.
+HOT_PATHS = {
+    "serving/engine.py": {
+        "ServingEngine._run", "ServingEngine.predict"},
+    "serving/service.py": {
+        "ServingService._worker", "ServingService._serve_batch",
+        "ServingService._serve_group", "ServingService._shadow_probe"},
+    "serving/replica.py": {
+        "Replica.predict", "FailoverRouter.predict",
+        "FailoverRouter._dispatch", "FailoverRouter._attempt",
+        "FailoverRouter._pick"},
+}
+
+#: Attribute reads that yield PYTHON values on a tracer (static under
+#: trace — accessing them is how shape-stable code is supposed to look).
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding",
+                "weak_type", "itemsize", "nbytes"}
+
+#: Callables that yield Python values (break traced flow). bool/int/
+#: float are NOT here — calling them on a tracer is the GL001 hazard.
+STATIC_CALLS = {"len", "isinstance", "type", "id", "repr", "str",
+                "hasattr", "getattr"}
+
+#: jax entry points whose function-valued arguments become traced roots
+#: (positional index -> which args are functions; -1 = first arg only).
+TRACE_ENTRY_SUFFIXES = (
+    "jax.jit", "jax.vmap", "jax.pmap", "jax.grad", "jax.value_and_grad",
+    "jax.lax.scan", "jax.lax.cond", "jax.lax.while_loop",
+    "jax.lax.fori_loop", "jax.lax.switch", "jax.lax.map",
+    "jax.lax.associative_scan", "jax.checkpoint", "jax.remat",
+)
+
+#: Host-escape wrappers: their callable argument runs on the HOST —
+#: trace scope must not propagate through them.
+HOST_ESCAPES = ("jax.debug.callback", "jax.pure_callback",
+                "jax.experimental.io_callback", "jax.debug.print",
+                "io_callback")
+
+
+# ---------------------------------------------------------------------
+# module loading / indexing
+# ---------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One function (or method) definition in the package."""
+
+    module: "ModuleInfo"
+    qualname: str               # dotted: Class.method / outer.<locals>.inner
+    node: ast.AST               # FunctionDef / AsyncFunctionDef / Lambda
+    parent_class: str | None = None
+
+    @property
+    def name(self) -> str:
+        return getattr(self.node, "name", "<lambda>")
+
+    @property
+    def key(self) -> tuple:
+        return (self.module.rel, self.qualname)
+
+    def params(self) -> list[str]:
+        a = self.node.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    """One parsed package module."""
+
+    rel: str                    # posix path relative to package root
+    path: str
+    tree: ast.Module
+    lines: list[str]
+    aliases: dict               # local name -> dotted external module
+    pkg_imports: dict           # local name -> (module rel, symbol)
+    functions: dict = dataclasses.field(default_factory=dict)
+    # qualname -> FunctionInfo (module-level + class methods + nested)
+
+    def src(self, node: ast.AST) -> str:
+        """The (first) source line of a node, stripped."""
+        try:
+            return self.lines[node.lineno - 1].strip()
+        except (IndexError, AttributeError):
+            return ""
+
+
+def load_package(root: str) -> dict[str, ModuleInfo]:
+    """Parse every ``.py`` under ``root`` into ModuleInfos keyed by
+    package-relative posix path. Unparseable files are skipped (the
+    interpreter would refuse them long before graftlint matters)."""
+    modules: dict[str, ModuleInfo] = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d != "__pycache__" and not d.startswith(".")]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                tree = ast.parse(source, filename=path)
+            except (OSError, SyntaxError):
+                continue
+            mod = ModuleInfo(rel=rel, path=path, tree=tree,
+                             lines=source.splitlines(),
+                             aliases={}, pkg_imports={})
+            _index_imports(mod)
+            _index_functions(mod)
+            modules[rel] = mod
+    return modules
+
+
+def _index_imports(mod: ModuleInfo) -> None:
+    """Alias map (local name -> dotted external module) and
+    package-import map (local name -> (module rel, symbol)).
+
+    Relative imports resolve against the CONTAINING package —
+    ``a/b.py`` and ``a/__init__.py`` both live in package ``a``, so
+    ``from .engine import x`` inside ``serving/__init__.py`` lands on
+    ``serving/engine.py`` (level N climbs N-1 packages from there)."""
+    pkg = mod.rel[:-3].split("/")[:-1]
+
+    def rel_base(level: int) -> list | None:
+        climb = level - 1
+        if climb > len(pkg):
+            return None  # beyond the package root: unresolvable
+        return pkg[:len(pkg) - climb] if climb else list(pkg)
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mod.aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.level and node.module is not None:
+                # relative: resolve against the package tree
+                base = rel_base(node.level)
+                if base is None:
+                    continue
+                target = base + node.module.split(".")
+                target_rel = "/".join(target) + ".py"
+                for a in node.names:
+                    mod.pkg_imports[a.asname or a.name] = (
+                        target_rel, a.name)
+            elif node.level and node.module is None:
+                base = rel_base(node.level)
+                if base is None:
+                    continue
+                for a in node.names:
+                    # from . import x -> module x.py in the package
+                    target_rel = "/".join(base + [a.name]) + ".py"
+                    mod.pkg_imports[a.asname or a.name] = (
+                        target_rel, None)
+            elif node.module is not None:
+                # absolute from-import: record the dotted source so
+                # `from jax import lax` classifies lax.scan correctly
+                for a in node.names:
+                    mod.aliases[a.asname or a.name] = (
+                        f"{node.module}.{a.name}")
+
+
+def _index_functions(mod: ModuleInfo) -> None:
+    def visit(node, prefix, parent_class):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                mod.functions[q] = FunctionInfo(
+                    module=mod, qualname=q, node=child,
+                    parent_class=parent_class)
+                visit(child, f"{q}.<locals>.", parent_class)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, f"{prefix}{child.name}.", child.name)
+            else:
+                visit(child, prefix, parent_class)
+
+    visit(mod.tree, "", None)
+
+
+# ---------------------------------------------------------------------
+# name / call resolution
+# ---------------------------------------------------------------------
+
+def dotted_name(expr: ast.AST, mod: ModuleInfo) -> str | None:
+    """Best-effort dotted name of a call target / attribute chain,
+    resolved through the module's import aliases: ``np.asarray`` ->
+    ``numpy.asarray``, ``lax.scan`` (from jax import lax) ->
+    ``jax.lax.scan``. None when the base is not a plain name."""
+    parts: list[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if not isinstance(expr, ast.Name):
+        return None
+    base = mod.aliases.get(expr.id, expr.id)
+    return ".".join([base] + list(reversed(parts)))
+
+
+def trace_entry_kind(dotted: str | None) -> str | None:
+    """'jit' / 'scan' / 'vmap' / ... when the dotted callable is a jax
+    trace entry point, else None. From-imported bare names already
+    arrive fully qualified (``from jax import jit`` records the alias
+    ``jit -> jax.jit``, which :func:`dotted_name` applies), so a bare
+    tail is NEVER accepted on its own — builtin ``map`` must not
+    classify as ``jax.lax.map`` and start minting false traced roots."""
+    if dotted is None:
+        return None
+    for full in TRACE_ENTRY_SUFFIXES:
+        tail = full.split(".")[-1]
+        if dotted == full:
+            return tail
+        if dotted.endswith("." + tail) and \
+                dotted.split(".")[0] in ("jax", "lax", "jnp"):
+            return tail
+    return None
+
+
+def is_host_escape(dotted: str | None) -> bool:
+    if dotted is None:
+        return False
+    if dotted in HOST_ESCAPES:
+        return True
+    tails = {h.split(".")[-1] for h in HOST_ESCAPES}
+    return (dotted.split(".")[-1] in tails
+            and dotted.split(".")[0] in ("jax", "io_callback"))
+
+
+def resolve_callable(expr: ast.AST, mod: ModuleInfo,
+                     local_defs: dict | None = None):
+    """Resolve a call target to a package FunctionInfo when possible.
+
+    ``local_defs``: qualname-keyed nested defs visible at the call site
+    (the enclosing function's locals). Returns FunctionInfo or None.
+    """
+    if isinstance(expr, ast.Name):
+        if local_defs and expr.id in local_defs:
+            return local_defs[expr.id]
+        if expr.id in mod.functions:
+            return mod.functions[expr.id]
+        imp = mod.pkg_imports.get(expr.id)
+        if imp is not None:
+            target_rel, symbol = imp
+            target = _lookup_module(target_rel)
+            if target is not None and symbol is not None:
+                return target.functions.get(symbol)
+    return None
+
+
+def _lookup_module(target_rel: str):
+    """A package module by resolved path — direct hit first, then the
+    package spelling (``serving.py`` -> ``serving/__init__.py``)."""
+    mod = _PACKAGE.get(target_rel)
+    if mod is None:
+        mod = _PACKAGE.get(target_rel[:-3] + "/__init__.py")
+    return mod
+
+
+#: Set by lint_package so cross-module resolution can see every module.
+_PACKAGE: dict[str, ModuleInfo] = {}
+
+
+def set_package(modules: dict[str, ModuleInfo]) -> None:
+    _PACKAGE.clear()
+    _PACKAGE.update(modules)
+    _RETURN_MEMO.clear()
+
+
+# ---------------------------------------------------------------------
+# traced-scope discovery
+# ---------------------------------------------------------------------
+
+def jit_static_params(call: ast.Call, fn: FunctionInfo) -> set[str]:
+    """Parameter names a jit call marks static (excluded from traced)."""
+    static: set[str] = set()
+    names = fn.params()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(
+                        n.value, str):
+                    static.add(n.value)
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(
+                        n.value, int) and 0 <= n.value < len(names):
+                    static.add(names[n.value])
+    return static
+
+
+def _decorator_trace_info(fn: FunctionInfo):
+    """(is_traced, static_params) from the def's decorator list."""
+    for dec in fn.node.decorator_list:
+        if isinstance(dec, ast.Call):
+            d = dotted_name(dec.func, fn.module)
+            if d is not None and d.split(".")[-1] == "partial" \
+                    and dec.args:
+                inner = dotted_name(dec.args[0], fn.module)
+                if trace_entry_kind(inner) == "jit":
+                    return True, jit_static_params(dec, fn)
+            if trace_entry_kind(d) == "jit":
+                return True, jit_static_params(dec, fn)
+        else:
+            if trace_entry_kind(dotted_name(dec, fn.module)) in (
+                    "jit", "vmap", "checkpoint", "remat"):
+                return True, set()
+    return False, set()
+
+
+def collect_trace_roots(modules: dict[str, ModuleInfo]):
+    """Every (FunctionInfo, traced-param set) that enters trace scope
+    directly: jit decorators, and function-valued arguments to jax
+    trace entry points anywhere in the package."""
+    roots: list[tuple[FunctionInfo, frozenset]] = []
+    for mod in modules.values():
+        for fn in list(mod.functions.values()):
+            traced, static = _decorator_trace_info(fn)
+            if traced:
+                roots.append((fn, frozenset(
+                    p for p in fn.params() if p not in static)))
+        # call-site roots: jax.jit(f), lax.scan(body, ...), vmap(f)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = trace_entry_kind(dotted_name(node.func, mod))
+            if kind is None:
+                continue
+            fn_args = []
+            if kind in ("cond", "switch"):
+                # every function-valued argument is a traced branch
+                fn_args = [a for a in node.args
+                           if isinstance(a, ast.Name)]
+            elif kind in ("while_loop",):
+                fn_args = [a for a in node.args[:2]
+                           if isinstance(a, ast.Name)]
+            elif kind in ("fori_loop",):
+                fn_args = [a for a in node.args[2:3]
+                           if isinstance(a, ast.Name)]
+            else:
+                fn_args = [a for a in node.args[:1]
+                           if isinstance(a, ast.Name)]
+            for arg in fn_args:
+                target = _resolve_name_anywhere(arg.id, mod)
+                if target is None:
+                    continue
+                if kind == "jit":
+                    static = jit_static_params(node, target)
+                    traced = frozenset(p for p in target.params()
+                                       if p not in static)
+                else:
+                    traced = frozenset(target.params())
+                roots.append((target, traced))
+    return roots
+
+
+def _resolve_name_anywhere(name: str, mod: ModuleInfo):
+    """A Name used as a function argument: module-level def, any nested
+    def with that terminal name (call sites inside the enclosing
+    function see it), or a package import."""
+    if name in mod.functions:
+        return mod.functions[name]
+    for q, fi in mod.functions.items():
+        if q.endswith(f".<locals>.{name}"):
+            return fi
+    imp = mod.pkg_imports.get(name)
+    if imp is not None:
+        target = _lookup_module(imp[0])
+        if target is not None and imp[1] is not None:
+            return target.functions.get(imp[1])
+    return None
+
+
+# ---------------------------------------------------------------------
+# traced dataflow: GL001 / GL005 hazards inside one traced function
+# ---------------------------------------------------------------------
+
+#: numpy concretization entry points (GL001 when fed a traced value).
+NUMPY_CONCRETIZERS = {"asarray", "array", "ascontiguousarray",
+                      "asfortranarray", "copy"}
+
+#: wall-clock reads (GL005 anywhere in traced code).
+WALLCLOCK_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
+                   "time.time_ns", "time.perf_counter_ns",
+                   "datetime.datetime.now", "datetime.datetime.utcnow",
+                   "datetime.now", "datetime.utcnow"}
+
+
+def _short(node: ast.AST, limit: int = 48) -> str:
+    try:
+        s = ast.unparse(node)
+    except Exception:
+        return "<expr>"
+    return s if len(s) <= limit else s[:limit - 3] + "..."
+
+
+#: Return-tracedness memo: (fn.key, frozenset(traced params)) ->
+#: bool | None (None = analysis in progress; a recursive cycle reads
+#: False — conservative toward fewer findings). Cleared per lint run.
+_RETURN_MEMO: dict = {}
+
+
+def returns_traced(fn: "FunctionInfo", traced_params) -> bool:
+    """Whether ``fn``'s return value derives from its traced params —
+    the interprocedural refinement that keeps trace-time-static
+    helpers (kernel resolvers, structure probes returning strings /
+    bools of ``len``/``isinstance``) from poisoning the caller's flow.
+    """
+    key = (fn.key, frozenset(traced_params))
+    if key in _RETURN_MEMO:
+        v = _RETURN_MEMO[key]
+        return bool(v)
+    _RETURN_MEMO[key] = None
+    flow = TracedFlow(fn, traced_params)
+    flow.run()
+    _RETURN_MEMO[key] = flow.returns_traced
+    return flow.returns_traced
+
+
+class TracedFlow(ast.NodeVisitor):
+    """Forward tracedness flow through ONE function body.
+
+    Emits ``hazards`` — ``(rule, node, message)`` — and ``calls`` —
+    ``(FunctionInfo, frozenset(traced param names))`` for package
+    callees reached from this traced scope (the interprocedural edge
+    the driver follows). ``returns_traced`` records whether any return
+    value derives from the traced inputs (consumed by the
+    return-tracedness memo above).
+    """
+
+    def __init__(self, fn: FunctionInfo, traced_params,
+                 seed_traced=frozenset()):
+        self.fn = fn
+        self.mod = fn.module
+        self.traced = set(traced_params) | set(seed_traced)
+        self.hazards: list[tuple] = []
+        self.calls: list[tuple] = []
+        self.local_defs: dict = {}
+        self.returns_traced = False
+
+    def run(self) -> "TracedFlow":
+        for stmt in self.fn.node.body:
+            self.visit(stmt)
+        return self
+
+    # -- call-target resolution (shared by flow + propagation) --------
+    def _call_target(self, node: ast.Call):
+        """``(FunctionInfo, frozenset(traced callee params))`` for a
+        package-resolvable call, else ``(None, frozenset())``."""
+        target = None
+        func = node.func
+        if isinstance(func, ast.Name):
+            target = self.local_defs.get(func.id) or \
+                _resolve_name_anywhere(func.id, self.mod)
+        elif isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id == "self" and self.fn.parent_class:
+            target = self.mod.functions.get(
+                f"{self.fn.parent_class}.{func.attr}")
+        if target is None:
+            return None, frozenset()
+        params = target.params()
+        if target.parent_class is not None and params and \
+                params[0] == "self":
+            params = params[1:]
+        traced_params = set()
+        for i, a in enumerate(node.args):
+            if i < len(params) and self.is_traced(a):
+                traced_params.add(params[i])
+        for kw in node.keywords:
+            if kw.arg is not None and kw.arg in params and \
+                    self.is_traced(kw.value):
+                traced_params.add(kw.arg)
+        return target, frozenset(traced_params)
+
+    # -- tracedness of an expression ----------------------------------
+    def is_traced(self, node) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.traced
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.is_traced(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.is_traced(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.is_traced(node.left) or self.is_traced(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_traced(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.is_traced(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return False  # structural: static under trace
+            return (self.is_traced(node.left)
+                    or any(self.is_traced(c) for c in node.comparators))
+        if isinstance(node, ast.IfExp):
+            return self.is_traced(node.body) or self.is_traced(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.is_traced(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.is_traced(node.value)
+        if isinstance(node, ast.Call):
+            dotted = dotted_name(node.func, self.mod)
+            if dotted is not None and dotted.split(".")[-1] in \
+                    STATIC_CALLS:
+                return False
+            if dotted in ("bool", "int", "float"):
+                # concretized: the RESULT is a Python scalar (the call
+                # itself is the GL001 hazard, reported at visit_Call)
+                return False
+            if isinstance(node.func, ast.Attribute) and \
+                    self.is_traced(node.func.value):
+                return True  # method on a traced value
+            target, tp = self._call_target(node)
+            if target is not None:
+                # package callee: the RESULT is traced only when its
+                # return value derives from the traced arguments (a
+                # trace-time-static resolver returning strings/flags
+                # must not poison the caller's flow)
+                return returns_traced(target, tp)
+            return any(self.is_traced(a) for a in node.args) or \
+                any(self.is_traced(kw.value) for kw in node.keywords)
+        return False
+
+    # -- assignment flow ----------------------------------------------
+    def _bind(self, target, traced: bool, value=None) -> None:
+        if isinstance(target, ast.Name):
+            if traced:
+                self.traced.add(target.id)
+            else:
+                self.traced.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            if isinstance(value, (ast.Tuple, ast.List)) and \
+                    len(value.elts) == len(target.elts):
+                for t, v in zip(target.elts, value.elts):
+                    self._bind(t, self.is_traced(v), v)
+            else:
+                for t in target.elts:
+                    self._bind(t, traced)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, traced)
+        # attribute/subscript stores: no local name to track
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit(node.value)
+        traced = self.is_traced(node.value)
+        for t in node.targets:
+            self._bind(t, traced, node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self.visit(node.value)
+            self._bind(node.target, self.is_traced(node.value),
+                       node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.visit(node.value)
+        if isinstance(node.target, ast.Name) and \
+                self.is_traced(node.value):
+            self.traced.add(node.target.id)
+
+    def visit_For(self, node: ast.For) -> None:
+        self.visit(node.iter)
+        self._bind(node.target, self.is_traced(node.iter))
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def visit_withitem(self, node: ast.withitem) -> None:
+        self.visit(node.context_expr)
+        if node.optional_vars is not None:
+            self._bind(node.optional_vars,
+                       self.is_traced(node.context_expr))
+
+    # -- hazards ------------------------------------------------------
+    def _hazard(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.hazards.append((rule, node, msg))
+
+    def visit_If(self, node: ast.If) -> None:
+        self.visit(node.test)
+        if self.is_traced(node.test):
+            self._hazard(
+                "GL001", node,
+                f"Python `if {_short(node.test)}` on a traced value — "
+                "concretizes at trace time (use jnp.where / lax.cond)")
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def visit_While(self, node: ast.While) -> None:
+        self.visit(node.test)
+        if self.is_traced(node.test):
+            self._hazard(
+                "GL001", node,
+                f"Python `while {_short(node.test)}` on a traced value "
+                "— concretizes at trace time (use lax.while_loop)")
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        if self.is_traced(node.test):
+            self._hazard(
+                "GL001", node,
+                f"conditional expression on traced `{_short(node.test)}`"
+                " — concretizes at trace time (use jnp.where)")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        if self.is_traced(node.test):
+            self._hazard(
+                "GL001", node,
+                f"assert on traced `{_short(node.test)}` — concretizes "
+                "at trace time (use checkify or a host-side check)")
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # a def inside a traced scope: register for call resolution and
+        # analyze with the CURRENT traced names as its closure seed
+        # (scan bodies close over the enclosing jit's traced arguments)
+        q = None
+        for qual, fi in self.mod.functions.items():
+            if fi.node is node:
+                q = fi
+                break
+        if q is not None:
+            self.local_defs[node.name] = q
+            sub = TracedFlow(q, frozenset(), seed_traced=frozenset(
+                self.traced))
+            sub.run()
+            self.hazards.extend(sub.hazards)
+            self.calls.extend(sub.calls)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        dotted = dotted_name(node.func, self.mod)
+        # host escapes: the callable argument runs host-side — do not
+        # walk into it or propagate trace scope through it
+        if is_host_escape(dotted):
+            return
+        # GL001: explicit concretizers
+        if dotted in ("bool", "int", "float"):
+            for a in node.args:
+                if self.is_traced(a):
+                    self._hazard(
+                        "GL001", node,
+                        f"`{dotted}({_short(a)})` concretizes a traced "
+                        "value at trace time")
+        if dotted is not None and "." in dotted:
+            base, tail = dotted.split(".", 1)
+            if base == "numpy" and tail.split(".")[-1] in \
+                    NUMPY_CONCRETIZERS:
+                for a in node.args:
+                    if self.is_traced(a):
+                        self._hazard(
+                            "GL001", node,
+                            f"`np.{tail.split('.')[-1]}({_short(a)})` "
+                            "forces a device->host transfer of a traced"
+                            " value (use jnp inside traced code)")
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "item" and \
+                self.is_traced(node.func.value):
+            self._hazard(
+                "GL001", node,
+                f"`{_short(node.func.value)}.item()` concretizes a "
+                "traced value at trace time")
+        # GL005: host randomness / wall clock inside traced code
+        if dotted is not None:
+            if dotted.startswith("numpy.random.") or \
+                    dotted == "numpy.random":
+                self._hazard(
+                    "GL005", node,
+                    f"`{_short(node)}` — numpy randomness in traced "
+                    "code runs ONCE at trace time and bakes a constant "
+                    "(use jax.random with a threaded key)")
+            elif dotted.split(".")[0] == "random" and \
+                    self.mod.aliases.get("random", "random") == "random":
+                self._hazard(
+                    "GL005", node,
+                    f"`{_short(node)}` — stdlib randomness in traced "
+                    "code runs ONCE at trace time and bakes a constant "
+                    "(use jax.random with a threaded key)")
+            elif dotted in WALLCLOCK_CALLS:
+                self._hazard(
+                    "GL005", node,
+                    f"`{_short(node)}` — wall-clock read in traced "
+                    "code is baked at trace time (pass times in as "
+                    "arguments)")
+        # interprocedural edge: package callees reached from here
+        self._propagate(node)
+        self.generic_visit(node)
+
+    def visit_Return(self, node: ast.Return) -> None:
+        if node.value is not None:
+            self.visit(node.value)
+            if self.is_traced(node.value):
+                self.returns_traced = True
+
+    def _propagate(self, node: ast.Call) -> None:
+        target, traced_params = self._call_target(node)
+        if target is not None:
+            self.calls.append((target, traced_params))

@@ -1,0 +1,123 @@
+"""graftlint CLI: ``python -m tools.graftlint [path] [--format json]``.
+
+Exit status: 0 when zero unsuppressed, non-baselined findings; 1
+otherwise. Text output is one finding per line (path:line: RULE
+message); JSON output carries the versioned ``GRAFTLINT.v1`` schema
+(gated by ``tools/check_bench_schema.py`` like the bench artifacts),
+with the suppressed findings and their reasons reported alongside —
+an audit trail, not a silence.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import ALL_RULES, RULES, SCHEMA, default_package_root, run_lint
+from .suppress import apply_baseline, load_baseline, save_baseline
+
+
+def report_json(package: str, findings, suppressed, baselined,
+                rules_run=None) -> dict:
+    """``rules_run``: the rules this run actually executed (a
+    ``--rules`` subset must not emit an artifact indistinguishable
+    from a full clean run — the counts table covers exactly what
+    ran, and the gate cross-checks the two)."""
+    rules_run = tuple(rules_run) if rules_run else ALL_RULES
+    counts = {r: 0 for r in rules_run}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return {
+        "schema": SCHEMA,
+        "package": package,
+        "rules": {r: {"title": t, "catches": c, "runtime_twin": twin}
+                  for r, (t, c, twin) in sorted(RULES.items())},
+        "rules_run": sorted(rules_run),
+        "counts": counts,
+        "findings": [f.to_json() for f in findings],
+        "baselined": [f.to_json() for f in baselined],
+        "suppressed": [f.to_json() for f in suppressed],
+        "clean": not findings,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="repo-native static analysis for the jax_graft "
+                    "invariants (GL001-GL006)")
+    ap.add_argument("path", nargs="?", default=None,
+                    help="package root to lint (default: the shipped "
+                         "package)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (e.g. "
+                         "GL001,GL004)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: the committed "
+                         "tools/graftlint/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline entirely")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept every current finding into the "
+                         "baseline file and exit 0 (adoption aid; "
+                         "this repo keeps the committed baseline "
+                         "EMPTY)")
+    args = ap.parse_args(argv)
+
+    root = args.path or default_package_root()
+    rules = None
+    if args.rules:
+        rules = tuple(r.strip() for r in args.rules.split(","))
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(f"graftlint: unknown rule(s) {unknown}; have "
+                  f"{sorted(RULES)}", file=sys.stderr)
+            return 2
+    try:
+        findings, suppressed = run_lint(root, rules=rules)
+    except FileNotFoundError as e:
+        # a missing/typo'd root must never report clean (exit 2, not
+        # 1: "nothing was linted" is a usage error, not a finding)
+        print(str(e), file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        path = save_baseline(findings, args.baseline)
+        print(f"graftlint: wrote {len(findings)} fingerprint(s) to "
+              f"{path}")
+        return 0
+
+    baseline = set() if args.no_baseline else load_baseline(
+        args.baseline)
+    findings, baselined = apply_baseline(findings, baseline)
+
+    if args.format == "json":
+        print(json.dumps(report_json(root, findings, suppressed,
+                                     baselined, rules_run=rules),
+                         indent=1, sort_keys=True))
+        return 1 if findings else 0
+
+    for f in findings:
+        print(f"{f.path}:{f.line}: {f.rule} {f.message}")
+        if f.context:
+            print(f"    {f.context}")
+    for f in baselined:
+        print(f"{f.path}:{f.line}: {f.rule} [baselined] {f.message}")
+    if suppressed:
+        print(f"-- {len(suppressed)} suppressed finding(s):")
+        for f in suppressed:
+            print(f"   {f.path}:{f.line}: {f.rule} ({f.reason})")
+    if findings:
+        print(f"graftlint: {len(findings)} finding(s) in {root}",
+              file=sys.stderr)
+        return 1
+    print(f"graftlint: clean ({len(suppressed)} suppressed, "
+          f"{len(baselined)} baselined)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

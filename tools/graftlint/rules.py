@@ -1,0 +1,622 @@
+"""Rule implementations GL001–GL006 over the scope inference.
+
+Each rule is a pure function over the loaded package returning raw
+hazards ``(rule, module, node, message)``; :func:`lint_package` runs
+them, anchors findings to source lines, and applies the inline
+suppressions (``suppress.py``). Scope decisions live in ``astscope.py``
+— rules only pattern-match within the scopes it hands them.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from collections import deque
+
+from . import ALL_RULES, Finding
+from .astscope import (HOT_PATHS, TracedFlow, _resolve_name_anywhere,
+                       _short, collect_trace_roots, dotted_name,
+                       load_package, set_package, trace_entry_kind)
+from .suppress import split_suppressed
+
+#: Blocking-call classification for GL004: dotted externals.
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep",
+    "open": "file I/O (open)",
+    "os.listdir": "file I/O (os.listdir)",
+    "os.scandir": "file I/O (os.scandir)",
+    "os.remove": "file I/O (os.remove)",
+    "os.makedirs": "file I/O (os.makedirs)",
+    "os.rename": "file I/O (os.rename)",
+    "os.replace": "file I/O (os.replace)",
+    "os.stat": "file I/O (os.stat)",
+    "shutil.rmtree": "file I/O (shutil.rmtree)",
+    "shutil.copytree": "file I/O (shutil.copytree)",
+    "subprocess.run": "subprocess.run",
+    "subprocess.check_output": "subprocess",
+    "wait": "concurrent.futures.wait",
+}
+
+#: Attribute-call patterns that block: attr -> (label, value-source
+#: hint substrings; empty = always).
+_BLOCKING_ATTRS = {
+    "predict": ("engine dispatch (.predict)", ()),
+    "warmup": ("ladder compile (.warmup)", ()),
+    "result": ("Future.result", ()),
+    "shutdown": ("executor shutdown", ()),
+    "sleep": ("sleep", ()),
+    "acquire": ("nested lock acquire", ()),
+    "wait": ("wait", ()),
+    "join": ("thread join", ("thread",)),
+    "get": ("queue.get", ("_q", "queue")),
+    "put": ("queue.put", ("_q", "queue")),
+    "write": ("file write", ("file",)),
+    "flush": ("file flush", ("file",)),
+    "read": ("file read", ("file",)),
+}
+
+#: GL003 device->host conversion entry points (numpy tails).
+_NP_CONVERTERS = {"asarray", "array", "ascontiguousarray", "copy"}
+
+
+# ---------------------------------------------------------------------
+# GL001 / GL005: traced-scope hazards (interprocedural driver)
+# ---------------------------------------------------------------------
+
+def rule_traced(modules) -> list[tuple]:
+    """Walk every traced root, following package-internal calls with
+    traced arguments; union the traced-param sets per function so a
+    callee reached from two scopes is analyzed once with both."""
+    out = []
+    seen: dict = {}
+    queue = deque(collect_trace_roots(modules))
+    guard = 0
+    while queue and guard < 10_000:
+        guard += 1
+        fn, traced = queue.popleft()
+        prev = seen.get(fn.key)
+        union = (prev or frozenset()) | traced
+        if prev is not None and union == prev:
+            continue
+        seen[fn.key] = union
+        flow = TracedFlow(fn, union).run()
+        for rule, node, msg in flow.hazards:
+            out.append((rule, fn.module, node,
+                        f"{msg} [in traced scope "
+                        f"{fn.qualname}]"))
+        for callee, tp in flow.calls:
+            queue.append((callee, tp))
+    return out
+
+
+# ---------------------------------------------------------------------
+# GL002 / GL003: serving hot paths
+# ---------------------------------------------------------------------
+
+def _raise_lines(fn_node) -> set[int]:
+    """Line numbers inside ``raise`` statements — error paths are not
+    hot, and their messages legitimately interpolate shapes."""
+    lines: set[int] = set()
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Raise):
+            for sub in ast.walk(node):
+                if hasattr(sub, "lineno"):
+                    lines.add(sub.lineno)
+    return lines
+
+
+def _contains_shape_attr(expr) -> ast.Attribute | None:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Attribute) and sub.attr in ("shape",
+                                                           "dtype"):
+            return sub
+    return None
+
+
+def rule_hot_paths(modules) -> list[tuple]:
+    out = []
+    for rel, quals in HOT_PATHS.items():
+        mod = modules.get(rel)
+        if mod is None:
+            continue
+        for q in sorted(quals):
+            fn = mod.functions.get(q)
+            if fn is None:
+                continue
+            out.extend(_lint_hot_function(mod, fn))
+    return out
+
+
+def _lint_hot_function(mod, fn) -> list[tuple]:
+    out = []
+    raise_ln = _raise_lines(fn.node)
+    device: set[str] = set()
+
+    def mark_device(target, is_dev: bool) -> None:
+        if isinstance(target, ast.Name):
+            if is_dev:
+                device.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for t in target.elts:
+                mark_device(t, is_dev)
+
+    def is_dispatch(call: ast.Call) -> bool:
+        f = call.func
+        return isinstance(f, ast.Attribute) and f.attr in ("predict",
+                                                           "_predict")
+
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            if is_dispatch(node.value):
+                for t in node.targets:
+                    mark_device(t, True)
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = dotted_name(node.func, mod)
+        # GL003: explicit device sync
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "block_until_ready":
+            out.append((
+                "GL003", mod, node,
+                f"`{_short(node)}` blocks the serving thread on device "
+                f"completion inside hot path {fn.qualname}"))
+        # GL003: device->numpy conversion of a dispatch result
+        if dotted is not None and "." in dotted:
+            base, tail = dotted.rsplit(".", 1)
+            if base.split(".")[0] == "numpy" and \
+                    tail in _NP_CONVERTERS:
+                for a in node.args:
+                    if isinstance(a, ast.Name) and a.id in device:
+                        out.append((
+                            "GL003", mod, node,
+                            f"`np.{tail}({a.id})` transfers the engine "
+                            "dispatch result device->host (a blocking "
+                            f"sync) inside hot path {fn.qualname}"))
+        if dotted in ("float", "int"):
+            for a in node.args:
+                if isinstance(a, ast.Name) and a.id in device:
+                    out.append((
+                        "GL003", mod, node,
+                        f"`{dotted}({a.id})` synchronizes on the "
+                        "dispatch result inside hot path "
+                        f"{fn.qualname}"))
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "item" and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in device:
+            out.append((
+                "GL003", mod, node,
+                f"`{_short(node)}` synchronizes on the dispatch result "
+                f"inside hot path {fn.qualname}"))
+        # GL002: fresh jit / AOT compile per dispatch
+        if trace_entry_kind(dotted) == "jit":
+            out.append((
+                "GL002", mod, node,
+                f"fresh `jax.jit` construction inside hot path "
+                f"{fn.qualname} — a new jit per call compiles per "
+                "call (build once at engine construction)"))
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("lower", "compile") and \
+                node.lineno not in raise_ln:
+            out.append((
+                "GL002", mod, node,
+                f"`.{node.func.attr}(...)` inside hot path "
+                f"{fn.qualname} — explicit compilation on the "
+                "dispatch path"))
+        # GL002: shape/dtype interpolated into a cache/dispatch key
+        if node.lineno not in raise_ln and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("add", "setdefault", "get"):
+            for a in node.args:
+                attr = _contains_shape_attr(a)
+                if attr is not None:
+                    out.append((
+                        "GL002", mod, node,
+                        f"array `.{attr.attr}` used as a cache key in "
+                        f"hot path {fn.qualname} — every new shape "
+                        "mints a new entry (the recompile-hazard "
+                        "pattern the compile_count pins watch)"))
+    # GL002: shape/dtype inside subscript keys (cache[x.shape] = ...)
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Subscript) and \
+                getattr(node, "lineno", 0) not in raise_ln:
+            attr = _contains_shape_attr(node.slice)
+            if attr is not None:
+                out.append((
+                    "GL002", mod, node,
+                    f"array `.{attr.attr}` used as a subscript key in "
+                    f"hot path {fn.qualname} — shape-keyed dispatch "
+                    "mints one entry per shape"))
+    return out
+
+
+# ---------------------------------------------------------------------
+# GL004: lock discipline
+# ---------------------------------------------------------------------
+
+def _lock_types(mod) -> dict[tuple, str]:
+    """``(class or None, tail identifier) -> 'Lock'/'RLock'/...`` for
+    every lock constructed in the module — keyed by the owning class so
+    two classes both naming ``self._lock`` (one Lock, one RLock) do
+    not shadow each other."""
+    types: dict[tuple, str] = {}
+
+    def record(node, cls):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            return
+        d = dotted_name(node.value.func, mod)
+        if d is None or not d.startswith("threading."):
+            return
+        kind = d.split(".")[-1]
+        if kind not in ("Lock", "RLock", "Condition", "Semaphore",
+                        "BoundedSemaphore"):
+            return
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                types[(None, t.id)] = kind
+            elif isinstance(t, ast.Attribute):
+                types[(cls, t.attr)] = kind
+
+    for top in ast.walk(mod.tree):
+        if isinstance(top, ast.ClassDef):
+            for node in ast.walk(top):
+                record(node, top.name)
+        else:
+            record(top, None)
+    return types
+
+
+def _lock_kind(types: dict, fn, tail: str) -> str:
+    """The lock's constructor kind as seen from ``fn`` — the owning
+    class's assignment first, module-level second, Lock (the strict
+    default) when never seen."""
+    for key in ((fn.parent_class, tail), (None, tail)):
+        if key in types:
+            return types[key]
+    return "Lock"
+
+
+def _lock_tail(expr) -> str | None:
+    """The identifier a with-item locks on, when it looks like a lock."""
+    if isinstance(expr, ast.Name):
+        name = expr.id
+    elif isinstance(expr, ast.Attribute):
+        name = expr.attr
+    else:
+        return None
+    return name if "lock" in name.lower() else None
+
+
+def _direct_blocking(call: ast.Call, mod) -> str | None:
+    dotted = dotted_name(call.func, mod)
+    if dotted in _BLOCKING_DOTTED:
+        return _BLOCKING_DOTTED[dotted]
+    if dotted is not None:
+        tail = dotted.split(".")[-1]
+        head = dotted.split(".")[0]
+        if head in ("os", "shutil", "subprocess") and \
+                f"{head}.{tail}" in _BLOCKING_DOTTED:
+            return _BLOCKING_DOTTED[f"{head}.{tail}"]
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        rec = _BLOCKING_ATTRS.get(attr)
+        if rec is not None:
+            label, hints = rec
+            if not hints:
+                return label
+            try:
+                vs = ast.unparse(call.func.value).lower()
+            except Exception:
+                vs = ""
+            if any(h in vs for h in hints):
+                return label
+    return None
+
+
+def _function_subtrees(body) -> set[int]:
+    """ids of nodes inside nested function defs (they do not execute
+    under the enclosing lock — only their CALL does)."""
+    inner: set[int] = set()
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                for sub in ast.walk(node):
+                    if sub is not node:
+                        inner.add(id(sub))
+    return inner
+
+
+def _blocking_functions(mod) -> dict[str, str]:
+    """qualname -> blocking label, to fixpoint over same-module calls."""
+    blocking: dict[str, str] = {}
+    changed = True
+    passes = 0
+    while changed and passes < 8:
+        changed = False
+        passes += 1
+        for q, fi in mod.functions.items():
+            if q in blocking:
+                continue
+            label = None
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                lbl = _direct_blocking(node, mod)
+                if lbl is not None:
+                    label = lbl
+                    break
+                callee = _resolve_local_call(node, fi, mod)
+                if callee is not None and callee.qualname in blocking:
+                    label = (f"call to {callee.qualname} "
+                             f"({blocking[callee.qualname]})")
+                    break
+            if label is not None:
+                blocking[q] = label
+                changed = True
+    return blocking
+
+
+def _resolve_local_call(call: ast.Call, fn, mod):
+    func = call.func
+    if isinstance(func, ast.Name):
+        target = mod.functions.get(func.id)
+        if target is None:
+            target = _resolve_name_anywhere(func.id, mod)
+            if target is not None and target.module is not mod:
+                return None  # same-module closure only (conservative)
+        return target
+    if isinstance(func, ast.Attribute) and \
+            isinstance(func.value, ast.Name) and \
+            func.value.id == "self" and fn.parent_class:
+        return mod.functions.get(f"{fn.parent_class}.{func.attr}")
+    return None
+
+
+def _acquires_lock(fn, lock_src: str) -> bool:
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                try:
+                    if ast.unparse(item.context_expr) == lock_src:
+                        return True
+                except Exception:
+                    continue
+    return False
+
+
+def rule_locks(modules) -> list[tuple]:
+    out = []
+    for mod in modules.values():
+        types = _lock_types(mod)
+        blocking = _blocking_functions(mod)
+        for q, fi in mod.functions.items():
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.With):
+                    continue
+                for item in node.items:
+                    tail = _lock_tail(item.context_expr)
+                    if tail is None:
+                        continue
+                    kind = _lock_kind(types, fi, tail)
+                    try:
+                        lock_src = ast.unparse(item.context_expr)
+                    except Exception:
+                        lock_src = tail
+                    out.extend(_lint_lock_body(
+                        mod, fi, node, lock_src, kind, blocking))
+    return out
+
+
+def _lint_lock_body(mod, fn, with_node, lock_src, kind,
+                    blocking) -> list[tuple]:
+    out = []
+    skip = _function_subtrees(with_node.body)
+    for stmt in with_node.body:
+        for node in ast.walk(stmt):
+            if id(node) in skip:
+                continue
+            if isinstance(node, ast.With) and node is not with_node:
+                for item in node.items:
+                    try:
+                        inner = ast.unparse(item.context_expr)
+                    except Exception:
+                        continue
+                    if inner == lock_src and kind != "RLock":
+                        out.append((
+                            "GL004", mod, node,
+                            f"`{lock_src}` re-acquired inside its own "
+                            f"with-block in {fn.qualname} — a "
+                            "threading.Lock is not reentrant; this "
+                            "deadlocks"))
+            if not isinstance(node, ast.Call):
+                continue
+            label = _direct_blocking(node, mod)
+            if label is not None:
+                out.append((
+                    "GL004", mod, node,
+                    f"`{lock_src}` held across {label} in "
+                    f"{fn.qualname} — blocking under a lock stalls "
+                    "every thread contending for it"))
+                continue
+            callee = _resolve_local_call(node, fn, mod)
+            if callee is None:
+                continue
+            if callee.qualname in blocking:
+                out.append((
+                    "GL004", mod, node,
+                    f"`{lock_src}` held across call to "
+                    f"{callee.qualname} ({blocking[callee.qualname]}) "
+                    f"in {fn.qualname}"))
+            elif kind != "RLock" and lock_src.startswith("self.") and \
+                    _acquires_lock(callee, lock_src):
+                out.append((
+                    "GL004", mod, node,
+                    f"`{lock_src}` re-acquired by callee "
+                    f"{callee.qualname} while held in {fn.qualname} — "
+                    "a threading.Lock is not reentrant; this "
+                    "deadlocks"))
+    return out
+
+
+# ---------------------------------------------------------------------
+# GL006: exception hygiene on serving threads
+# ---------------------------------------------------------------------
+
+def _thread_targets(modules):
+    """FunctionInfos passed as Thread(target=...) or pool.submit(f)."""
+    roots = []
+    for mod in modules.values():
+        for fi in mod.functions.values():
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted_name(node.func, mod)
+                cand = None
+                if d is not None and d.endswith("Thread"):
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            cand = kw.value
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "submit" and node.args:
+                    cand = node.args[0]
+                if cand is None:
+                    continue
+                target = None
+                if isinstance(cand, ast.Name):
+                    target = _resolve_name_anywhere(cand.id, mod)
+                elif isinstance(cand, ast.Attribute) and \
+                        isinstance(cand.value, ast.Name) and \
+                        cand.value.id == "self" and fi.parent_class:
+                    target = mod.functions.get(
+                        f"{fi.parent_class}.{cand.attr}")
+                if target is not None:
+                    roots.append(target)
+    return roots
+
+
+def _gl006_scope(modules):
+    """Serving modules wholesale + thread targets (and their same-
+    module callees) elsewhere."""
+    scope = {}
+    for rel, mod in modules.items():
+        if rel.startswith("serving/"):
+            for fi in mod.functions.values():
+                scope[fi.key] = fi
+    queue = deque(_thread_targets(modules))
+    while queue:
+        fi = queue.popleft()
+        if fi.key in scope:
+            continue
+        scope[fi.key] = fi
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                callee = _resolve_local_call(node, fi, fi.module)
+                if callee is not None and callee.key not in scope:
+                    queue.append(callee)
+    return scope
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _handler_accounts(handler: ast.ExceptHandler) -> bool:
+    """Whether a broad handler does something accountable with the
+    failure: re-raises, uses the caught exception (stores/forwards
+    it), counts it into metrics/error telemetry, or increments a
+    counter (``self.requeues += 1`` — the failover accounting shape)."""
+    caught = handler.name
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.AugAssign)):
+            return True
+        if caught is not None and isinstance(node, ast.Name) and \
+                node.id == caught and isinstance(node.ctx, ast.Load):
+            return True
+        if isinstance(node, ast.Attribute):
+            a = node.attr.lower()
+            if a.startswith("record_") or "metric" in a or \
+                    "error" in a or a == "set_exception":
+                return True
+    return False
+
+
+def rule_exceptions(modules) -> list[tuple]:
+    out = []
+    for fi in _gl006_scope(modules).values():
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if not _is_broad(handler):
+                    continue
+                if _handler_accounts(handler):
+                    continue
+                what = ("bare `except:`" if handler.type is None else
+                        f"`except {_short(handler.type)}`")
+                out.append((
+                    "GL006", fi.module, handler,
+                    f"{what} in serving-thread code ({fi.qualname}) "
+                    "swallows the failure — count it into metrics, "
+                    "re-raise typed, or narrow the exception type"))
+    return out
+
+
+# ---------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------
+
+_RULE_FNS = (rule_traced, rule_hot_paths, rule_locks, rule_exceptions)
+
+
+def lint_package(root: str, rules=None):
+    """Run every rule over the package at ``root``; returns
+    ``(findings, suppressed)`` — both sorted, deduplicated, and with
+    inline suppressions applied (reasonless disables do NOT suppress).
+    """
+    want = set(rules) if rules else set(ALL_RULES)
+    modules = load_package(root)
+    if not modules:
+        # a missing/typo'd root or an empty tree must FAIL loudly: a
+        # gate that linted zero files and reported clean is the exact
+        # silent-green failure graftlint exists to stop
+        raise FileNotFoundError(
+            f"graftlint: no Python modules found under {root!r} — "
+            "wrong path?")
+    set_package(modules)
+    raw: list[tuple] = []
+    for rule_fn in _RULE_FNS:
+        raw.extend(rule_fn(modules))
+    findings = []
+    seen = set()
+    for rule, mod, node, msg in raw:
+        if rule not in want:
+            continue
+        line = getattr(node, "lineno", 0)
+        key = (rule, mod.rel, line)
+        if key in seen:
+            continue
+        seen.add(key)
+        findings.append(Finding(rule=rule, path=mod.rel, line=line,
+                                message=msg, context=mod.src(node)))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    # occurrence-index identical (rule, file, source-text) findings in
+    # line order so their baseline fingerprints stay distinct
+    occ: dict = {}
+    for i, f in enumerate(findings):
+        key = (f.rule, f.path, f.context.strip())
+        n = occ.get(key, 0)
+        occ[key] = n + 1
+        if n:
+            findings[i] = dataclasses.replace(f, occurrence=n)
+    return split_suppressed(findings, modules)

@@ -1,0 +1,114 @@
+"""Inline suppressions + baseline handling.
+
+A finding is suppressible ONLY inline, on its own line or the line
+directly above::
+
+    out = np.asarray(out)[:n]  # graftlint: disable=GL003 <reason>
+
+The reason is mandatory: a bare ``disable=GL003`` does not suppress
+(an unexplained opt-out is indistinguishable from a drive-by silence,
+and the whole point of a repo-native linter is that every exception is
+an argued one). Multiple rules: ``disable=GL003,GL004 reason...``.
+
+The baseline (``tools/graftlint/baseline.json``) is the escape hatch
+for adopting the linter on a codebase with pre-existing findings —
+entries are finding fingerprints (rule + file + normalized source
+text, line-number free so they survive unrelated edits). THIS repo
+commits it empty: every pre-existing true finding was fixed or
+inline-suppressed with a reason in the PR that introduced graftlint,
+and ``tests/test_graftlint.py`` gates it at zero tier-1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+
+#: ``# graftlint: disable=GL001[,GL002...] [reason]``
+_DISABLE_RE = re.compile(
+    r"#\s*graftlint:\s*disable=([A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)"
+    r"\s*(.*)$")
+
+BASELINE_NAME = "baseline.json"
+
+
+def parse_disables(line: str):
+    """``(rules, reason)`` of a suppression comment on ``line``, or
+    None. The reason may be empty — the CALLER decides that an empty
+    reason does not suppress (and reports it)."""
+    m = _DISABLE_RE.search(line)
+    if m is None:
+        return None
+    rules = tuple(r.strip() for r in m.group(1).split(","))
+    return rules, m.group(2).strip()
+
+
+def split_suppressed(findings, modules):
+    """Partition findings into (active, suppressed) per the inline
+    comments in their modules. A reasonless disable suppresses nothing
+    and surfaces as its own note on the finding."""
+    active, suppressed = [], []
+    for f in findings:
+        mod = modules.get(f.path)
+        verdict = None
+        if mod is not None:
+            for ln in (f.line, f.line - 1):
+                if 1 <= ln <= len(mod.lines):
+                    verdict = parse_disables(mod.lines[ln - 1])
+                    if verdict is not None:
+                        break
+        if verdict is not None and f.rule in verdict[0]:
+            rules_, reason = verdict
+            if reason:
+                suppressed.append(dataclasses.replace(
+                    f, suppressed=True, reason=reason))
+                continue
+            f = dataclasses.replace(
+                f, message=f.message + " [suppression ignored: "
+                "no reason given — `# graftlint: "
+                "disable=GLNNN <why>`]")
+        active.append(f)
+    return active, suppressed
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        BASELINE_NAME)
+
+
+def load_baseline(path: str | None = None) -> set[str]:
+    """Fingerprints accepted as pre-existing. Missing file == empty
+    baseline (the strict default); a malformed file raises — a silently
+    ignored baseline would un-gate every finding it listed."""
+    path = path or default_baseline_path()
+    if not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        obj = json.load(f)
+    if not isinstance(obj, dict) or "fingerprints" not in obj or \
+            not isinstance(obj["fingerprints"], list):
+        raise ValueError(
+            f"malformed baseline {path!r}: expected "
+            '{"fingerprints": [...]}')
+    return set(str(x) for x in obj["fingerprints"])
+
+
+def save_baseline(findings, path: str | None = None) -> str:
+    path = path or default_baseline_path()
+    with open(path, "w") as f:
+        json.dump({"fingerprints": sorted(
+            {fi.fingerprint for fi in findings})}, f, indent=1,
+            sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def apply_baseline(findings, baseline: set[str]):
+    """(new, baselined) — a finding whose fingerprint is in the
+    baseline does not fail the gate, but still reports."""
+    new, old = [], []
+    for f in findings:
+        (old if f.fingerprint in baseline else new).append(f)
+    return new, old
