@@ -352,16 +352,22 @@ def bench_trace_overhead(ds, D, rounds, platform):
     — and report the ratio. The traced run records the train-scan span
     plus per-round records host-side AFTER the dispatch returns, so
     the expected overhead is ~zero; this leg makes that measured, not
-    assumed. Returns the JSON record or None on failure/skip (a side
-    leg must never cost the headline metric).
+    assumed. Since ISSUE 12 the same configure path also feeds the
+    process-global telemetry REGISTRY (per-round loss/accuracy series,
+    ``utils.telemetry``), so the measured cost now prices the whole
+    training-side plane and the record reports how many series points
+    it produced. Returns the JSON record or None on failure/skip (a
+    side leg must never cost the headline metric).
 
     Env: BENCH_NO_TRACE=1 skips."""
     if os.environ.get("BENCH_NO_TRACE"):
         return None
+    from fedamw_tpu.utils import telemetry as telemetry_mod
     from fedamw_tpu.utils import trace as trace_mod
 
     try:
         off_ups, _, off_dt = bench_jax(ds, D, rounds)
+        registry = telemetry_mod.reset_registry()
         tracer = trace_mod.configure(max_spans=10 * rounds + 16)
         try:
             on_ups, _, on_dt = bench_jax(ds, D, rounds)
@@ -374,10 +380,12 @@ def bench_trace_overhead(ds, D, rounds, platform):
     # the traced leg's warmup ALSO records spans; only the timed run's
     # matter for the contract (>= 1 scan span + rounds round records)
     spans = tracer.records()
+    points = registry.points_recorded()
     overhead = off_ups / on_ups if on_ups > 0 else float("inf")
     print(f"# trace leg: traced {on_ups:.1f} updates/s vs untraced "
           f"{off_ups:.1f} updates/s -> {overhead:.3f}x overhead "
-          f"({len(spans)} spans)", file=sys.stderr)
+          f"({len(spans)} spans, {points} telemetry points)",
+          file=sys.stderr)
     return {
         "metric": "trace_overhead",
         "value": round(overhead, 3),
@@ -385,6 +393,8 @@ def bench_trace_overhead(ds, D, rounds, platform):
         "traced_updates_per_sec": round(on_ups, 2),
         "untraced_updates_per_sec": round(off_ups, 2),
         "spans_recorded": len(spans),
+        "telemetry_points": points,
+        "telemetry_instruments": len(registry.instruments()),
         "platform": platform,
     }
 

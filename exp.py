@@ -451,6 +451,27 @@ def main():
             print(format_trace_summary(f"exp1_{args.dataset}",
                                        tracer.records()))
             print(f"trace ({n_spans} spans) -> {tpath}")
+            # the telemetry-registry twin (ISSUE 12): the per-round
+            # time series the round scans recorded behind the same
+            # configure path, dumped as a TELEMETRY.v1 snapshot plus
+            # its Prometheus rendering — tools/obs_export.py converts
+            # either (with the trace above) to OTLP JSON
+            import json as _json
+
+            from fedamw_tpu.utils import telemetry as telemetry_mod
+
+            reg = telemetry_mod.get_registry()
+            if reg.points_recorded():
+                mpath = os.path.join(
+                    args.trace_dir,
+                    f"exp1_{args.dataset}_telemetry.json")
+                with open(mpath, "w") as f:
+                    _json.dump(reg.dump(), f)
+                with open(mpath[:-len(".json")] + ".prom", "w") as f:
+                    f.write(telemetry_mod.render_prometheus(reg))
+                print(f"telemetry ({len(reg.instruments())} series, "
+                      f"{reg.points_recorded()} points) -> {mpath} "
+                      "(+ .prom)")
 
     data_ = {
         "epochs": R,

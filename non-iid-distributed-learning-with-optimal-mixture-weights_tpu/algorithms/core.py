@@ -74,6 +74,7 @@ from ..fedcore.robust import (
     zscore_quarantine,
 )
 from ..ops.schedule import lr_schedule_array
+from ..utils.telemetry import get_registry
 from ..utils.trace import get_tracer
 from .common import FedSetup, result_tuple
 
@@ -524,6 +525,16 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 stream_metrics(t, train_loss_t, tl, ta)
                 ys = {"train_loss": train_loss_t, "test_loss": tl,
                       "test_acc": ta}
+                # FedAMW's own round dynamics as per-round metrics
+                # (ISSUE 12): the learned mixture's entropy and max
+                # mass — two scalar reductions stacked through the
+                # scan like every other metric. Double-where keeps
+                # 0 * log(0) an exact zero (a masked-out client's
+                # weight IS zero under dropout/quarantine)
+                p_safe = jnp.where(p > 0, p, 1.0)
+                ys["p_entropy"] = -jnp.sum(
+                    jnp.where(p > 0, p * jnp.log(p_safe), 0.0))
+                ys["p_max"] = jnp.max(p)
                 if faults_on:
                     ys["quarantined"] = quar_t
                 ys.update(dfaux)
@@ -1312,6 +1323,12 @@ def _round_based(
         defense["client_valid"] = (
             np.asarray(setup.sizes) > 0).astype(int)
         out["defense"] = defense
+    if "p_entropy" in metrics:
+        # the learned mixture's per-round dynamics (FedAMW's own
+        # signal, ISSUE 12): entropy collapse / single-client mass
+        # concentration is visible as a trajectory, not just a final p
+        out["mixture"] = {"p_entropy": metrics["p_entropy"],
+                          "p_max": metrics["p_max"]}
     _emit_round_spans(out, metrics, aggregation, robust_canonical,
                       faults_on, start_round, stop, t_scan0, scan_s)
     if return_state:
@@ -1515,7 +1532,15 @@ def _emit_round_spans(out, metrics, aggregation, robust_canonical,
     round boundaries — per-round duration is the scan wall-clock
     attributed uniformly, and every round record says so
     (``attrs["timing"] == "uniform"``); the counters and losses are
-    exact per-round data either way."""
+    exact per-round data either way.
+
+    The same per-round data additionally lands in the process-global
+    telemetry registry (``utils.telemetry``, ISSUE 12) as TIME SERIES
+    — loss/accuracy gauges, fault and defense counters, reputation
+    stats, and FedAMW's mixture dynamics (p-entropy / p-max) — so a
+    training run's rolling signals export through the same
+    Prometheus/OTLP surfaces as serving's. Gated behind the SAME
+    tracer configure path: one ``exp.py --trace_dir`` turns both on."""
     tracer = get_tracer()
     if not tracer.enabled:
         return
@@ -1529,6 +1554,44 @@ def _emit_round_spans(out, metrics, aggregation, robust_canonical,
     per = scan_s / max(1, n_r)
     fc = out.get("fault_counts", {})
     dfz = out.get("defense", {})
+    mix = out.get("mixture", {})
+    registry = get_registry()
+    labels = {"agg": aggregation}
+    gauges = {
+        k: registry.gauge(f"fed_{k}", h, labels=labels)
+        for k, h in (("train_loss", "per-round training loss"),
+                     ("test_loss", "per-round test loss"),
+                     ("test_acc", "per-round test accuracy"))}
+    fault_counters = {
+        k: registry.counter("fed_faults_total",
+                            "per-round fault-plane counts, by kind",
+                            labels={**labels, "kind": k})
+        for k in fc}
+    defense_counters = {
+        k: registry.counter("fed_defense_total",
+                            "per-round defense verdicts, by kind",
+                            labels={**labels, "kind": k})
+        for k in ("z_quarantined", "rep_gated", "frac_clamped")
+        if k in dfz}
+    rep = dfz.get("reputation")
+    if rep is not None:
+        valid = np.asarray(
+            dfz.get("client_valid", np.ones(rep.shape[1])), bool)
+        rep_mean = registry.gauge("fed_reputation_mean",
+                                  "mean reputation of real clients",
+                                  labels=labels)
+        rep_min = registry.gauge("fed_reputation_min",
+                                 "least-trusted real client's score",
+                                 labels=labels)
+    mix_gauges = {
+        k: registry.gauge(f"fed_{k}",
+                          "FedAMW learned-mixture dynamics",
+                          labels=labels)
+        for k in mix}
+    # round timestamps on the REGISTRY's clock basis: the scan ended
+    # "now", rounds attributed uniformly backwards — same uniform
+    # attribution as the spans, stated in their timing attr
+    t_end = registry.clock()
     for i in range(n_r):
         attrs = {
             "round": start_round + i,
@@ -1537,13 +1600,29 @@ def _emit_round_spans(out, metrics, aggregation, robust_canonical,
             "test_acc": float(metrics["test_acc"][i]),
             "timing": "uniform",
         }
+        t_i = t_end - scan_s + (i + 1) * per
+        for k, g in gauges.items():
+            g.set(attrs[k], t=t_i)
         for k in ("dropped", "straggled", "corrupted", "lied",
                   "quarantined"):
             if k in fc:
                 attrs[k] = int(fc[k][i])
+        for k, c in fault_counters.items():
+            c.inc(int(fc[k][i]), t=t_i)
         for k in ("z_quarantined", "rep_gated", "frac_clamped"):
             if k in dfz:
                 attrs[k] = int(dfz[k][i])
+        for k, c in defense_counters.items():
+            c.inc(int(dfz[k][i]), t=t_i)
+        if rep is not None:
+            row = np.asarray(rep[i], float)[valid]
+            if row.size:
+                rep_mean.set(float(row.mean()), t=t_i)
+                rep_min.set(float(row.min()), t=t_i)
+        for k, g in mix_gauges.items():
+            v = float(mix[k][i])
+            attrs[k] = v
+            g.set(v, t=t_i)
         tracer.emit("round", run_id, t_scan0 + i * per, per,
                     parent_id=scan_id, **attrs)
 

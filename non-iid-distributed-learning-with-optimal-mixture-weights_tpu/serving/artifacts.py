@@ -293,6 +293,7 @@ def export_ladder(engine, out_dir: str, model_version: int | None = None,
     # a jit DISPATCH wrote) re-serializes with its fusion symbols
     # stripped — "Symbols not found: [...]" at load — so the artifact
     # must always hold freshly-compiled binaries; restored after
+    # graftlint: disable=GL004 the export IS blocking work under a process-wide lock by design: it flips the global jax compilation-cache flag, so two concurrent exports (or an export racing a cached dispatch) would corrupt each other's executables; contention is operator-grade (export CLI / watcher), never the serving hot path
     _EXPORT_LOCK.acquire()
     cache_was = jax.config.jax_enable_compilation_cache
     if cache_was:

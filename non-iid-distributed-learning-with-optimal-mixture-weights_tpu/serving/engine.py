@@ -645,6 +645,45 @@ class ServingEngine:
             self._timings = timings
         return out[0] if single else out
 
+    def device_attribution(self, reps: int = 8,
+                           bucket: int | None = None,
+                           seed: int = 0) -> dict:
+        """Sampled device-time attribution of this engine's dispatch
+        (the PR 5 follow-on): run ``reps`` dispatches of one ladder
+        rung under a single ``jax.profiler`` capture and correlate the
+        capture's DEVICE-lane busy time with the host-blocking
+        dispatch wall time (``utils.telemetry.attribute_device_time``)
+        — the split that takes XLA queue/transfer residency OUT of the
+        ``device_ms`` stage family
+        (``ServeMetrics.install_device_attribution``).
+
+        Out-of-band by construction: dispatches run with
+        ``record_timings=False`` so the probe can never bill its
+        timing or version into the serving worker's single-consumer
+        slot, and the probe is a sampled OPERATOR action (bench leg,
+        diagnostics), never per-request — a profiler capture per
+        request would be its own overhead story. On CPU (no device
+        lane in the capture) the result is the graceful
+        ``source="none"`` record, reason included."""
+        from ..utils.telemetry import attribute_device_time
+
+        b = int(bucket) if bucket is not None \
+            else self.buckets[len(self.buckets) // 2]
+        if b not in self.buckets:
+            raise ValueError(
+                f"bucket {b} is not a ladder rung {self.buckets}")
+        X = np.random.RandomState(seed).randn(
+            b, self.input_dim).astype(np.float32)
+
+        def dispatch() -> float:
+            t0 = time.perf_counter()
+            self.predict(X, record_timings=False)
+            return time.perf_counter() - t0
+
+        attr = attribute_device_time(dispatch, reps=reps)
+        attr["bucket"] = b
+        return attr
+
     def warmup(self) -> int:
         """Compile every bucket (zeros input); returns the compile
         count, after which a mixed-size stream triggers none. On an

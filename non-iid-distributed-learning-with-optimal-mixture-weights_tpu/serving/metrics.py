@@ -5,10 +5,32 @@ The histogram keeps raw samples up to a bound and computes percentiles
 by sorting at snapshot time — exact, and at serving-bench scale (1e4-1e5
 samples) far cheaper than maintaining quantile sketches. Past the bound
 it degrades to uniform reservoir sampling, so long-running services keep
-statistically honest tails instead of silently dropping the newest data.
+statistically honest tails instead of silently dropping the newest data
+— and SAYS so: snapshots carry ``seen`` vs ``sampled`` counts and a
+``reservoir_degraded`` flag, so a bench artifact can tell exact
+percentiles from sampled ones.
 
-``snapshot()`` emits the ``BENCH_SERVE_*`` field family the driver
-parses (``serve_bench.py``), same schema discipline as ``bench.py``.
+Since the ISSUE 12 telemetry plane, :class:`ServeMetrics` is re-based
+on the typed instrument registry (``utils/telemetry.py``): every
+counter/gauge is a registry instrument backed by a ring-buffer TIME
+SERIES, so rolling rates and SLO burn-rate signals are computable at
+any point (``ServeMetrics.slo()``), and the whole bundle exports
+through the standard wire shapes (Prometheus text, OTLP JSON). The
+``snapshot()`` dict stays contract-compatible — the existing
+``BENCH_SERVE_*`` field family is unchanged; new dimensions are
+additive (``tests/test_serve_contract.py`` is the proof). Request
+latency is additionally recorded per SLO CLASS (the
+``serve_request_latency_seconds{class=...}`` family) — the per-class
+attainment input of ROADMAP direction 4.
+
+Device-time attribution (the PR 5 follow-on): a sampled
+``jax.profiler`` probe (``ServingEngine.device_attribution``) installed
+via :meth:`ServeMetrics.install_device_attribution` splits the blocking
+``device_*`` stage family into actual device compute vs XLA
+queue/transfer residency (``device_compute_*`` / ``xla_queue_*`` —
+constant-fraction scaling of the measured family, exact for
+percentiles). On CPU the probe yields ``source="none"`` and the split
+is honestly absent.
 """
 
 from __future__ import annotations
@@ -16,6 +38,8 @@ from __future__ import annotations
 import random
 import threading
 import time
+
+from ..utils.telemetry import Registry, SloEvaluator
 
 
 class LatencyHistogram:
@@ -42,6 +66,29 @@ class LatencyHistogram:
     def count(self) -> int:
         return self._seen
 
+    @property
+    def sampled(self) -> int:
+        """Samples actually retained (== ``count`` until the reservoir
+        bound is hit, then pinned at ``max_samples``)."""
+        with self._lock:
+            return len(self._samples)
+
+    @property
+    def degraded(self) -> bool:
+        """True once ``percentiles()`` reports reservoir APPROXIMATIONS
+        rather than exact order statistics — the honesty flag snapshots
+        surface so an artifact can never pass a sampled tail off as an
+        exact one."""
+        with self._lock:
+            return self._seen > len(self._samples)
+
+    def accounting(self) -> dict:
+        """The honesty triple: ``{"seen", "sampled",
+        "reservoir_degraded"}``."""
+        with self._lock:
+            return {"seen": self._seen, "sampled": len(self._samples),
+                    "reservoir_degraded": self._seen > len(self._samples)}
+
     def percentiles(self, qs=(50, 95, 99)) -> dict[str, float]:
         """``{"p50_ms": ..., ...}`` — nearest-rank, in milliseconds."""
         with self._lock:
@@ -59,7 +106,16 @@ class LatencyHistogram:
 class ServeMetrics:
     """One bundle of everything the serve bench and contract tests
     assert on: request latency, rows/requests served, shedding, queue
-    pressure, and (via the engine) the compile-cache counter."""
+    pressure, and (via the engine) the compile-cache counter.
+
+    Counters/gauges are registry instruments (``self.registry``) so
+    every one is also a monotonic-timestamped time series; the integer
+    attributes the pre-registry surface exposed (``metrics.retries``
+    etc.) remain as read properties. Pass ``registry=`` to share one
+    registry across services or to run the plane in its cheap
+    series-off mode (``Registry(enabled=False)`` — what the paired
+    ``telemetry_overhead`` bench leg measures against).
+    """
 
     #: Per-request pipeline stages the service records
     #: (``service._serve_batch``): time queued before the batch formed,
@@ -68,7 +124,13 @@ class ServeMetrics:
     #: percentile families that let a tail regression localize.
     STAGES = ("queue", "pad", "device")
 
-    def __init__(self):
+    #: The sub-stage split of ``device`` the profiler attribution
+    #: unlocks (additive; present only when a ``source == "profiler"``
+    #: attribution is installed).
+    DEVICE_SPLIT = ("device_compute", "xla_queue")
+
+    def __init__(self, registry: Registry | None = None):
+        self.registry = registry if registry is not None else Registry()
         self.latency = LatencyHistogram()
         # request-level stage latencies: batch-shared stages (pad,
         # device) record once per REQUEST in the batch, so the
@@ -76,19 +138,45 @@ class ServeMetrics:
         # comparable to the end-to-end latency histogram above
         self.stage_latency = {s: LatencyHistogram() for s in self.STAGES}
         self._lock = threading.Lock()
-        self.requests_served = 0
-        self.rows_served = 0
-        self.batches = 0
-        self.shed_deadline = 0
-        self.shed_overload = 0
-        self.shed_shutdown = 0
-        self.retries = 0
-        self.requests_retried = 0
-        self.max_request_retries = 0
-        self.queue_depth_peak = 0
-        # rollout dimensions (ISSUE 6): which model answered, how far
-        # behind training it is, and the swap/canary counters the
-        # continuous-deployment loop reports
+        reg = self.registry
+        self._c_requests = reg.counter(
+            "serve_requests_total", "requests served")
+        self._c_rows = reg.counter("serve_rows_total", "rows served")
+        self._c_batches = reg.counter(
+            "serve_batches_total", "engine micro-batches dispatched")
+        self._c_shed = {
+            reason: reg.counter("serve_shed_total",
+                                "requests shed, by reason",
+                                labels={"reason": reason})
+            for reason in ("deadline", "overload", "shutdown")}
+        self._c_retries = reg.counter(
+            "serve_engine_retries_total",
+            "transient engine-dispatch retries")
+        self._c_requests_retried = reg.counter(
+            "serve_requests_retried_total",
+            "requests that saw at least one dispatch retry")
+        self._c_swaps = reg.counter(
+            "serve_weight_swaps_total", "hot weight swaps absorbed")
+        self._c_shadow = reg.counter(
+            "serve_shadow_requests_total",
+            "requests mirrored to a rollout candidate")
+        self._c_cand_err = reg.counter(
+            "serve_candidate_errors_total",
+            "candidate dispatch failures absorbed")
+        self._c_rollbacks = reg.counter(
+            "serve_rollbacks_total", "rollout rollbacks")
+        self._c_staleness_err = reg.counter(
+            "serve_staleness_errors_total",
+            "failed live staleness lookups")
+        self._g_queue_depth = reg.gauge(
+            "serve_queue_depth", "observed queue depth at submit")
+        self._g_staleness = reg.gauge(
+            "serve_staleness_rounds",
+            "rounds the live model trails the newest published one")
+        # per-SLO-class latency family (seconds): what SloEvaluator
+        # reads; children cached here so the per-batch path skips the
+        # registry's creation lock (idempotent either way)
+        self._lat_class: dict = {}
         self.requests_by_version: dict = {}
         self.model_version = None
         self.staleness_rounds = 0
@@ -98,22 +186,92 @@ class ServeMetrics:
         # itself falling behind as training publishes — the swap-time
         # cache alone would freeze at its last value
         self.staleness_of = None
-        self.weight_swaps = 0
-        self.shadow_requests = 0
-        self.candidate_errors = 0
-        self.rollbacks = 0
-        # failed staleness lookups (the injected staleness_of callable
-        # raising): the dimension degrades to its swap-time value, and
-        # this counter is how an operator learns the LIVE source broke
-        # instead of mistaking a frozen staleness for a healthy one
-        self.staleness_errors = 0
+        self._queue_depth_peak = 0
+        self._max_request_retries = 0
+        # the sampled profiler attribution (install_device_attribution)
+        self._device_attr: dict | None = None
         self._t_first = None
         self._t_last = None
 
-    def observe_queue_depth(self, depth: int) -> None:
+    # -- pre-registry integer surface (read compatibility) ------------
+    @property
+    def requests_served(self) -> int:
+        return int(self._c_requests.value)
+
+    @property
+    def rows_served(self) -> int:
+        return int(self._c_rows.value)
+
+    @property
+    def batches(self) -> int:
+        return int(self._c_batches.value)
+
+    @property
+    def shed_deadline(self) -> int:
+        return int(self._c_shed["deadline"].value)
+
+    @property
+    def shed_overload(self) -> int:
+        return int(self._c_shed["overload"].value)
+
+    @property
+    def shed_shutdown(self) -> int:
+        return int(self._c_shed["shutdown"].value)
+
+    @property
+    def retries(self) -> int:
+        return int(self._c_retries.value)
+
+    @property
+    def requests_retried(self) -> int:
+        return int(self._c_requests_retried.value)
+
+    @property
+    def max_request_retries(self) -> int:
         with self._lock:
-            if depth > self.queue_depth_peak:
-                self.queue_depth_peak = depth
+            return self._max_request_retries
+
+    @property
+    def queue_depth_peak(self) -> int:
+        with self._lock:
+            return self._queue_depth_peak
+
+    @property
+    def weight_swaps(self) -> int:
+        return int(self._c_swaps.value)
+
+    @property
+    def shadow_requests(self) -> int:
+        return int(self._c_shadow.value)
+
+    @property
+    def candidate_errors(self) -> int:
+        return int(self._c_cand_err.value)
+
+    @property
+    def rollbacks(self) -> int:
+        return int(self._c_rollbacks.value)
+
+    @property
+    def staleness_errors(self) -> int:
+        return int(self._c_staleness_err.value)
+
+    # -- recording ----------------------------------------------------
+    def _class_hist(self, slo_class: str):
+        hist = self._lat_class.get(slo_class)
+        if hist is None:
+            hist = self.registry.histogram(
+                "serve_request_latency_seconds",
+                "end-to-end request latency, by SLO class",
+                labels={"class": slo_class})
+            self._lat_class[slo_class] = hist
+        return hist
+
+    def observe_queue_depth(self, depth: int) -> None:
+        self._g_queue_depth.set(depth)
+        with self._lock:
+            if depth > self._queue_depth_peak:
+                self._queue_depth_peak = depth
 
     def record_shed(self, reason: str) -> None:
         """``reason``: 'deadline' (request expired while queued),
@@ -121,13 +279,7 @@ class ServeMetrics:
         dropped by a non-draining stop) — separable signals: an
         operator alerting on deadline violations must not page on a
         deliberate shutdown."""
-        with self._lock:
-            if reason == "deadline":
-                self.shed_deadline += 1
-            elif reason == "shutdown":
-                self.shed_shutdown += 1
-            else:
-                self.shed_overload += 1
+        self._c_shed.get(reason, self._c_shed["overload"]).inc()
 
     def record_swap(self, version, staleness_rounds: int = 0) -> None:
         """One hot weight swap: ``version`` is now live,
@@ -135,8 +287,9 @@ class ServeMetrics:
         (0 when it IS the newest). Called by the rollout controller on
         promote/revert — the dimension that lets an operator see the
         service keep pace with training."""
+        self._c_swaps.inc()
+        self._g_staleness.set(int(staleness_rounds))
         with self._lock:
-            self.weight_swaps += 1
             self.model_version = version
             self.staleness_rounds = int(staleness_rounds)
 
@@ -144,27 +297,23 @@ class ServeMetrics:
         """Shadow dispatches: requests mirrored to the candidate but
         answered from the live version (dark-launch traffic, never
         caller-visible)."""
-        with self._lock:
-            self.shadow_requests += int(n_requests)
+        self._c_shadow.inc(int(n_requests))
 
     def record_candidate_error(self, n_requests: int = 1) -> None:
         """Candidate dispatch failures absorbed by the live fallback
         (ab mode) or discarded (shadow mode) — what the rollout error
         budget counts."""
-        with self._lock:
-            self.candidate_errors += int(n_requests)
+        self._c_cand_err.inc(int(n_requests))
 
     def record_rollback(self) -> None:
-        with self._lock:
-            self.rollbacks += 1
+        self._c_rollbacks.inc()
 
     def record_staleness_error(self) -> None:
         """One failed staleness lookup (``staleness_of`` or a router's
         ``staleness_rounds`` raising) absorbed by a staleness-unknown
         default — counted so a broken registry hookup is visible
         instead of reading as a permanently-current service."""
-        with self._lock:
-            self.staleness_errors += 1
+        self._c_staleness_err.inc()
 
     def record_retry(self) -> None:
         """One transient engine-dispatch failure absorbed by the
@@ -172,27 +321,41 @@ class ServeMetrics:
         A nonzero steady rate is the operator's early-warning signal
         that the engine's backend is flapping even while every request
         still succeeds."""
+        self._c_retries.inc()
+
+    def install_device_attribution(self, attr: dict | None) -> None:
+        """Install a sampled device-time attribution record
+        (``ServingEngine.device_attribution`` /
+        ``utils.telemetry.attribute_device_time``). With
+        ``source == "profiler"`` the snapshot's ``device_*`` family
+        grows the ``device_compute_*`` / ``xla_queue_*`` split; any
+        other source (the CPU fallback) is surfaced verbatim so the
+        artifact records WHY the split is absent."""
         with self._lock:
-            self.retries += 1
+            self._device_attr = None if attr is None else dict(attr)
 
     def record_batch(self, n_requests: int, n_rows: int,
                      latencies: list[float],
                      now: float | None = None,
                      stage_seconds: dict | None = None,
                      request_retries: list[int] | None = None,
-                     version=None) -> None:
+                     version=None, slo_classes=None) -> None:
         """``stage_seconds``: ``{"queue": [per-request s, ...],
         "pad": s, "device": s}`` — scalar stages are batch-shared and
         recorded once per request (see ``stage_latency``).
         ``request_retries``: per-request transient-dispatch retry
         counts (the batch-level aggregate already rides
         :meth:`record_retry`). ``version``: which model version
-        answered this batch (per-version served counts)."""
+        answered this batch (per-version served counts).
+        ``slo_classes``: per-request SLO class names aligned with
+        ``latencies`` (default: every request in the "default" class)
+        — the label on the registry latency family the SLO evaluator
+        reads."""
         now = time.perf_counter() if now is None else now
+        self._c_batches.inc()
+        self._c_requests.inc(int(n_requests))
+        self._c_rows.inc(int(n_rows))
         with self._lock:
-            self.batches += 1
-            self.requests_served += n_requests
-            self.rows_served += n_rows
             if version is not None:
                 self.requests_by_version[version] = (
                     self.requests_by_version.get(version, 0) + n_requests)
@@ -200,12 +363,17 @@ class ServeMetrics:
                 self._t_first = now
             self._t_last = now
             if request_retries:
-                self.requests_retried += sum(1 for r in request_retries
-                                             if r > 0)
-                self.max_request_retries = max(self.max_request_retries,
-                                               *request_retries)
-        for s in latencies:
+                n_retried = sum(1 for r in request_retries if r > 0)
+                self._max_request_retries = max(self._max_request_retries,
+                                                *request_retries)
+            else:
+                n_retried = 0
+        if n_retried:
+            self._c_requests_retried.inc(n_retried)
+        for i, s in enumerate(latencies):
             self.latency.record(s)
+            cls = (slo_classes[i] if slo_classes else None) or "default"
+            self._class_hist(cls).observe(s)
         if stage_seconds:
             for stage, val in stage_seconds.items():
                 hist = self.stage_latency[stage]
@@ -216,46 +384,74 @@ class ServeMetrics:
                     for _ in range(n_requests):
                         hist.record(val)
 
+    # -- SLO / export surfaces ----------------------------------------
+    def slo(self, classes=None, windows_s=(60.0, 300.0)) -> dict:
+        """Per-class SLO attainment + burn rate over the latency
+        family's rolling windows (``utils.telemetry.SloEvaluator``) —
+        the admission-control / autoscaling signal. ``classes``
+        defaults to the plane's standard interactive/batch pair."""
+        from ..utils.telemetry import DEFAULT_SLO_CLASSES
+
+        ev = SloEvaluator(self.registry,
+                          classes=classes or DEFAULT_SLO_CLASSES,
+                          windows_s=windows_s)
+        return ev.evaluate()
+
     def snapshot(self, engine=None) -> dict:
         with self._lock:
             elapsed = ((self._t_last - self._t_first)
                        if self._t_first is not None
                        and self._t_last is not None
                        and self._t_last > self._t_first else None)
-            snap = {
-                "requests": self.requests_served,
-                "rows": self.rows_served,
-                "batches": self.batches,
-                "shed_deadline": self.shed_deadline,
-                "shed_overload": self.shed_overload,
-                "shed_shutdown": self.shed_shutdown,
-                "retries": self.retries,
-                "requests_retried": self.requests_retried,
-                "max_request_retries": self.max_request_retries,
-                "queue_depth_peak": self.queue_depth_peak,
-                "mean_batch_rows": (
-                    round(self.rows_served / self.batches, 2)
-                    if self.batches else None),
-                "throughput_req_per_s": (
-                    round(self.requests_served / elapsed, 2)
-                    if elapsed else None),
-                "throughput_rows_per_s": (
-                    round(self.rows_served / elapsed, 2)
-                    if elapsed else None),
-                # rollout dimensions: live version + how far behind
-                # training, swaps absorbed, canary traffic and its
-                # fallback/rollback counters, per-version served split
-                "model_version": self.model_version,
-                "staleness_rounds": self.staleness_rounds,
-                "weight_swaps": self.weight_swaps,
-                "shadow_requests": self.shadow_requests,
-                "candidate_errors": self.candidate_errors,
-                "rollbacks": self.rollbacks,
-                "requests_by_version": {
-                    str(k): v
-                    for k, v in sorted(self.requests_by_version.items())},
-            }
+            model_version = self.model_version
+            staleness_rounds = self.staleness_rounds
+            max_retries = self._max_request_retries
+            peak = self._queue_depth_peak
+            device_attr = (None if self._device_attr is None
+                           else dict(self._device_attr))
+            # copied under the lock: record_batch mutates this dict
+            # under the same lock, and an unlocked sorted() here could
+            # die mid-iteration on a concurrent first-version insert
+            by_version = dict(self.requests_by_version)
+        requests = self.requests_served
+        rows = self.rows_served
+        batches = self.batches
+        snap = {
+            "requests": requests,
+            "rows": rows,
+            "batches": batches,
+            "shed_deadline": self.shed_deadline,
+            "shed_overload": self.shed_overload,
+            "shed_shutdown": self.shed_shutdown,
+            "retries": self.retries,
+            "requests_retried": self.requests_retried,
+            "max_request_retries": max_retries,
+            "queue_depth_peak": peak,
+            "mean_batch_rows": (
+                round(rows / batches, 2) if batches else None),
+            "throughput_req_per_s": (
+                round(requests / elapsed, 2) if elapsed else None),
+            "throughput_rows_per_s": (
+                round(rows / elapsed, 2) if elapsed else None),
+            # rollout dimensions: live version + how far behind
+            # training, swaps absorbed, canary traffic and its
+            # fallback/rollback counters, per-version served split
+            "model_version": model_version,
+            "staleness_rounds": staleness_rounds,
+            "weight_swaps": self.weight_swaps,
+            "shadow_requests": self.shadow_requests,
+            "candidate_errors": self.candidate_errors,
+            "rollbacks": self.rollbacks,
+            "requests_by_version": {
+                str(k): v for k, v in sorted(by_version.items())},
+        }
         snap.update(self.latency.percentiles())
+        # the reservoir honesty triple (ISSUE 12 satellite): whether
+        # the percentiles above are exact order statistics or sampled
+        acct = self.latency.accounting()
+        snap["latency_seen"] = acct["seen"]
+        snap["latency_sampled"] = acct["sampled"]
+        snap["reservoir_degraded"] = acct["reservoir_degraded"]
         # per-stage percentile families (queue_p50_ms, pad_p95_ms,
         # device_p99_ms, ...): the request-level tracing ISSUE — a tail
         # regression in the end-to-end percentiles localizes to the
@@ -263,6 +459,23 @@ class ServeMetrics:
         for stage, hist in self.stage_latency.items():
             snap.update({f"{stage}_{k}": v
                          for k, v in hist.percentiles().items()})
+        # the profiler-backed device split (additive): the device stage
+        # scaled by the SAMPLED compute fraction — exact for
+        # percentiles under constant-fraction scaling, and labeled with
+        # its source so a reader can never mistake it for a
+        # per-request measurement. Absent (with the reason recorded)
+        # on hosts whose profiler yields no device lane (CPU).
+        snap["device_attribution"] = device_attr
+        if device_attr and device_attr.get("source") == "profiler":
+            frac = float(device_attr.get("compute_fraction", 0.0))
+            for q, v in self.stage_latency["device"].percentiles().items():
+                if v is None:
+                    split = {"device_compute": None, "xla_queue": None}
+                else:
+                    split = {"device_compute": round(v * frac, 4),
+                             "xla_queue": round(v * (1.0 - frac), 4)}
+                for name, sv in split.items():
+                    snap[f"{name}_{q}"] = sv
         if engine is not None:
             snap["compile_count"] = engine.compile_count
             if snap["model_version"] is None:

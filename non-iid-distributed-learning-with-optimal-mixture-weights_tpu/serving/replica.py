@@ -300,7 +300,14 @@ class FailoverRouter:
                  ewma_alpha: float = 0.2, hedge: bool = False,
                  hedge_percentile: int = 95, hedge_factor: float = 2.0,
                  hedge_floor_ms: float = 1.0,
-                 hedge_min_samples: int = 20):
+                 hedge_min_samples: int = 20, registry=None):
+        """``registry`` (``utils.telemetry.Registry``, optional): when
+        given, every successful dispatch additionally lands in the
+        ``serve_replica_dispatch_seconds{replica=N}`` histogram family
+        — the per-replica latency TIME SERIES the EWMA cannot provide
+        (an EWMA has no window percentiles), and the signal an
+        adaptive hedge threshold / autoscaler (ROADMAP direction 4)
+        reads. None keeps the router registry-free."""
         self.replicas = list(replicas)
         if not self.replicas:
             raise ValueError("FailoverRouter needs at least one replica")
@@ -340,6 +347,14 @@ class FailoverRouter:
         self.hedges_cancelled = 0
         self._rr = 0  # round-robin cursor (mutated under the lock)
         self._hist = LatencyHistogram(max_samples=4096)
+        # per-replica dispatch-latency series (built once: the
+        # registry's creation lock must not sit on the dispatch path)
+        self._reg_hist = {} if registry is None else {
+            r.replica_id: registry.histogram(
+                "serve_replica_dispatch_seconds",
+                "successful dispatch latency, by replica",
+                labels={"replica": r.replica_id})
+            for r in self.replicas}
         self._pool: ThreadPoolExecutor | None = None
         self._timings: dict | None = None
 
@@ -568,6 +583,12 @@ class FailoverRouter:
             self._health[rid].on_success(dt)
             self._counts[rid]["ok"] += 1
         self._hist.record(dt)
+        reg_hist = self._reg_hist.get(rid)
+        if reg_hist is not None:
+            # the telemetry-plane twin of the EWMA sample: a windowed
+            # per-replica latency series (outside the router lock —
+            # the instrument locks itself)
+            reg_hist.observe(dt)
         return out, timing
 
     def _hedge_timeout_s(self) -> float | None:

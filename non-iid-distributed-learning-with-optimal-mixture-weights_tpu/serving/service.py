@@ -104,6 +104,7 @@ class _Request:
     deadline: float | None  # absolute perf_counter time, or None
     id: str = ""  # request id assigned at submit; rides the whole path
     retries: int = 0  # transient engine-dispatch retries this request saw
+    slo: str = "default"  # SLO class label on the latency family
 
 
 class ServingService:
@@ -230,7 +231,7 @@ class ServingService:
         # IS the trace plane's overhead the serve bench measures
         attrs = {"outcome": outcome, "rows": request_rows(req.x),
                  "retries": req.retries, "model_version": version,
-                 "staleness_rounds": staleness}
+                 "staleness_rounds": staleness, "slo_class": req.slo}
         if queue_s is not None:
             attrs["queue_ms"] = queue_s * 1e3
         if pad_s is not None:
@@ -364,7 +365,8 @@ class ServingService:
                 latencies=[done - req.t_submit], now=done,
                 stage_seconds={"queue": [queue_s], "pad": pad_s,
                                "device": device_s},
-                request_retries=[req.retries], version=ver)
+                request_retries=[req.retries], version=ver,
+                slo_classes=[req.slo])
             self._trace_request(req, "ok", done, queue_s=queue_s,
                                 pad_s=pad_s, device_s=device_s,
                                 version=ver, extra=rext)
@@ -377,8 +379,16 @@ class ServingService:
         self.stop()
 
     # -- request side -------------------------------------------------
-    def submit(self, x, timeout_s: float | None = None) -> Future:
-        """Enqueue one request; sheds immediately when over capacity."""
+    def submit(self, x, timeout_s: float | None = None,
+               slo_class: str | None = None) -> Future:
+        """Enqueue one request; sheds immediately when over capacity.
+
+        ``slo_class`` labels the request on the metrics plane's
+        per-class latency family (``serve_request_latency_seconds
+        {class=...}``) — the SLO attainment/burn-rate input
+        (``ServeMetrics.slo()``). Purely observational today: class-
+        aware shedding and deadline scheduling are ROADMAP direction 4,
+        and they will read exactly this dimension."""
         if self._thread is None:
             raise RuntimeError("service not started")
         x = np.asarray(x, dtype=np.float32)
@@ -398,7 +408,8 @@ class ServingService:
         req = _Request(
             x=x, future=fut, t_submit=now,
             deadline=None if timeout_s is None else now + timeout_s,
-            id=self.tracer.new_id("req"))
+            id=self.tracer.new_id("req"),
+            slo=slo_class or "default")
         # the id is caller-visible: a client logging fut.request_id can
         # join its own records against the exported trace
         fut.request_id = req.id
@@ -709,7 +720,8 @@ class ServingService:
             stage_seconds={"queue": queue_waits, "pad": pad_s,
                            "device": device_s},
             request_retries=[r.retries for r in live],
-            version=served_ver)
+            version=served_ver,
+            slo_classes=[r.slo for r in live])
         stale = (self._staleness(served_ver) if self.tracer.enabled
                  else 0)  # constant across the group: look up once
         for req, q_s in zip(live, queue_waits):

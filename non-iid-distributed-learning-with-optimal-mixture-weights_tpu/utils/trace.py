@@ -51,6 +51,7 @@ host cannot see round boundaries, and the records say so via
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import itertools
 import json
 import os
@@ -349,9 +350,16 @@ class Tracer:
                 "(writer.paths lists the part files)")
         recs = self.records()
         with open(path, "w") as f:
+            # the wall/monotonic anchor pair lands in the HEADER only
+            # (spans stay wall-clock-free by design): exporters that
+            # need epoch timestamps (tools/obs_export.py -> OTLP) map
+            # the monotonic span times through it
             f.write(json.dumps({"schema": TRACE_SCHEMA,
                                 "spans": len(recs),
-                                "dropped": self.dropped}) + "\n")
+                                "dropped": self.dropped,
+                                "anchor_unix_s": time.time(),
+                                "anchor_mono_s": time.perf_counter()
+                                }) + "\n")
             for r in recs:
                 f.write(json.dumps({k: r[k] for k in SPAN_FIELDS}) + "\n")
         return len(recs)
@@ -367,6 +375,90 @@ def read_jsonl(path: str) -> tuple[dict, list[dict]]:
         raise ValueError(f"{path}: not a trace JSONL (missing "
                          f"{TRACE_SCHEMA!r}-family header line)")
     return lines[0], lines[1:]
+
+
+# ---------------------------------------------------------------------
+# Trace-context propagation (the DCN-hop contract, ROADMAP direction 1)
+# ---------------------------------------------------------------------
+
+#: Version tag of the serialized context carrier. Distinct from
+#: TRACE_SCHEMA: the carrier crosses a process boundary between
+#: possibly different builds, so its compatibility is its own contract.
+TRACECTX_SCHEMA = "TRACECTX.v1"
+
+#: The string-header spelling's field separator; ids are generated by
+#: :meth:`Tracer.new_id` (``prefix-N``) and never contain it.
+_CTX_SEP = ";"
+
+
+@dataclasses.dataclass(frozen=True)
+class SpanContext:
+    """The minimal cross-process span identity: which trace a remote
+    hop belongs to, and which span is its parent. A receiving process
+    emits its spans as ``tracer.span(name, ctx.trace_id,
+    parent_id=ctx.parent_id)`` — one request, one trace id, spans on
+    both sides of the boundary, exactly the "one span per request
+    across the DCN hop" contract direction 1 lands on."""
+
+    trace_id: str
+    parent_id: str | None = None
+
+
+def inject_context(trace_id: str, span_id: str | None = None) -> dict:
+    """Serialize a span identity for a process boundary: a flat
+    JSON-safe dict (``{"schema", "trace_id", "parent_id"}``). The
+    CURRENT span's id becomes the remote side's ``parent_id`` — the
+    remote spans hang under the local dispatch span."""
+    if not trace_id or not isinstance(trace_id, str):
+        raise ValueError(f"trace_id must be a non-empty string, got "
+                         f"{trace_id!r}")
+    for v in (trace_id, span_id):
+        if v is not None and _CTX_SEP in v:
+            raise ValueError(
+                f"id {v!r} contains the carrier separator "
+                f"{_CTX_SEP!r} — not a Tracer.new_id-shaped id")
+    return {"schema": TRACECTX_SCHEMA, "trace_id": trace_id,
+            "parent_id": span_id}
+
+
+def format_context(carrier: dict) -> str:
+    """The one-line header spelling of an injected carrier
+    (``TRACECTX.v1;trace_id;parent_id``) for transports that carry
+    strings, not dicts. Empty parent serializes as an empty field."""
+    if carrier.get("schema") != TRACECTX_SCHEMA:
+        raise ValueError(f"not a {TRACECTX_SCHEMA} carrier: "
+                         f"{carrier!r}")
+    return _CTX_SEP.join((TRACECTX_SCHEMA, carrier["trace_id"],
+                          carrier.get("parent_id") or ""))
+
+
+def extract_context(carrier) -> SpanContext:
+    """Inverse of :func:`inject_context` / :func:`format_context`:
+    accepts the dict or the string-header spelling, returns a
+    :class:`SpanContext`. Malformed carriers raise ``ValueError``
+    naming what is wrong — a dropped trace context on a cross-process
+    hop must be a loud bug, not a silently-orphaned span tree."""
+    if isinstance(carrier, str):
+        parts = carrier.split(_CTX_SEP)
+        if len(parts) != 3 or parts[0] != TRACECTX_SCHEMA:
+            raise ValueError(
+                f"malformed trace-context header {carrier!r} "
+                f"(expected '{TRACECTX_SCHEMA};trace_id;parent_id')")
+        _, trace_id, parent = parts
+    elif isinstance(carrier, dict):
+        if carrier.get("schema") != TRACECTX_SCHEMA:
+            raise ValueError(
+                f"carrier schema {carrier.get('schema')!r} is not "
+                f"{TRACECTX_SCHEMA}")
+        trace_id = carrier.get("trace_id")
+        parent = carrier.get("parent_id")
+    else:
+        raise ValueError(
+            f"carrier must be a dict or header string, got "
+            f"{type(carrier).__name__}")
+    if not trace_id:
+        raise ValueError(f"carrier {carrier!r} has no trace_id")
+    return SpanContext(trace_id=trace_id, parent_id=parent or None)
 
 
 #: The shared disabled tracer: emit/annotate are immediate returns and

@@ -78,6 +78,18 @@ composed with a MID-STREAM hot weight swap (chaos-under-rollout, the
 PR 7 follow-on): zero lost requests, zero recompiles, and the correct
 NEW model_version on every post-swap span are abort-grade.
 
+The ISSUE 12 telemetry leg (``telemetry_bench``, schema
+BENCH_SERVE.v5) prices the WHOLE observability plane paired: plane-off
+(series-disabled registry, no tracer) vs plane-on (registry time
+series + per-SLO-class latency family + request tracing + an
+installed ``jax.profiler`` device-attribution record), best-of-reps
+like the trace leg. Exactly-once spans and zero recompiles stay
+abort-grade; the <=5% bound is enforced on committed artifacts by
+``tools/check_bench_schema.py``. The artifact section carries the SLO
+evaluation (per-class attainment + burn rate) and the device
+attribution (the XLA-queue split on device hosts, the honest
+``source="none"`` fallback on CPU).
+
 Env knobs: SERVE_BUCKETS ("1,8,64,512"), SERVE_D (RFF width, 256),
 SERVE_N (train rows, 4096), SERVE_CLIENTS (8), SERVE_TRAIN_ROUNDS (2),
 SERVE_ITERS (per-bucket timed calls, 30), SERVE_REQUESTS (mixed-stream
@@ -87,7 +99,10 @@ plus one shadow canary), SERVE_CHAOS_REQUESTS (chaos-leg stream
 length, default max(SERVE_REQUESTS, 120) — long enough that the
 scripted per-replica kill indices land mid-stream), SERVE_CKPT (serve
 an existing checkpoint dir instead
-of training), SERVE_OUT, SERVE_ROUND (artifact suffix, default 1),
+of training), SERVE_TELEMETRY_REPS (paired telemetry-plane legs,
+default 5), SERVE_DEVATTR_REPS (profiled dispatches in the
+device-attribution probe, default 6),
+SERVE_OUT, SERVE_ROUND (artifact suffix, default 1),
 SERVE_TRACE (directory: export the traced leg's span records as JSONL
 there, and stream the rollout leg's spans there as rotating parts),
 SERVE_ARTIFACT_DIR (keep the cold-start leg's exported AOT artifact
@@ -181,14 +196,18 @@ def time_bucket(engine, b: int, iters: int, rng) -> dict:
 
 
 def mixed_stream(engine, n_requests: int, max_wait_ms: float, rng,
-                 tracer=None) -> dict:
+                 tracer=None, metrics=None, slo_classes=None) -> dict:
     """Drive a deterministic mixed-size request stream through the full
     service loop and snapshot its metrics (now including the per-stage
     queue/pad/device percentile families). Sizes mix single rows with
     every rung boundary's neighborhood so each compiled bucket serves
     real (non-warmup) traffic. ``tracer``: a live ``utils.trace``
     Tracer for the traced leg (every accepted request lands one
-    "request" span); None keeps the no-op default."""
+    "request" span); None keeps the no-op default. ``metrics``: a
+    prepared ``ServeMetrics`` (the telemetry leg passes one whose
+    registry is enabled or disabled — the paired plane-on/off
+    comparison); ``slo_classes``: a cycle of SLO class labels stamped
+    on submits, so the per-class latency family carries real traffic."""
     from fedamw_tpu.serving import ServingService
 
     sizes = []
@@ -204,8 +223,12 @@ def mixed_stream(engine, n_requests: int, max_wait_ms: float, rng,
     # SERVE_REQUESTS would crash with Overloaded instead of measuring
     with ServingService(engine, max_wait_ms=max_wait_ms,
                         max_queue=max(1024, n_requests),
-                        tracer=tracer) as svc:
-        futures = [svc.submit(x) for x in payloads]
+                        tracer=tracer, metrics=metrics) as svc:
+        futures = [
+            svc.submit(x, slo_class=(
+                slo_classes[i % len(slo_classes)] if slo_classes
+                else None))
+            for i, x in enumerate(payloads)]
         for f in futures:
             f.result(timeout=300)
         dt = time.perf_counter() - t0
@@ -665,6 +688,110 @@ def cold_start_bench(ckpt, buckets, setup, X_test_raw):
     return section
 
 
+def telemetry_bench(engine, n_requests, max_wait_ms):
+    """The ISSUE 12 unified-telemetry leg: what the WHOLE plane costs,
+    measured paired. Plane OFF = a ``ServeMetrics`` whose registry
+    runs series-disabled and the no-op tracer (cumulative counters
+    only — the pre-ISSUE-12 cost floor); plane ON = live registry
+    time series + per-SLO-class latency family + request tracing +
+    an installed device-attribution record. Same paired
+    best-of-``SERVE_TELEMETRY_REPS`` estimator as the trace leg
+    (identical request-size streams per rep; max-throughput shrugs
+    off scheduler noise). Abort-grade pins, like parity: every
+    submitted request of the winning ON leg lands exactly one span,
+    and the compile count stays flat across every leg — the plane
+    must observe the ladder, never perturb it. The <=5% overhead
+    bound is enforced on COMMITTED artifacts by
+    ``tools/check_bench_schema.py`` (v5); a live run past it prints a
+    loud warning instead of aborting, so a noisy box cannot flake the
+    gate. The sampled ``jax.profiler`` device-attribution probe runs
+    once OUTSIDE the paired timing (its cost is reported separately —
+    it is an operator action, not a per-request one); on CPU it
+    degrades to the honest ``source="none"`` record. Returns the
+    artifact ``telemetry_overhead`` section (BENCH_SERVE.v5)."""
+    from fedamw_tpu.serving import ServeMetrics
+    from fedamw_tpu.utils.telemetry import Registry
+    from fedamw_tpu.utils.trace import Tracer
+
+    # floored HERE so the artifact's 'reps' records what actually ran
+    # (SERVE_TELEMETRY_REPS=0 must not write a reps=0 the schema gate
+    # would rightly reject after a green run)
+    reps = max(1, _env_int("SERVE_TELEMETRY_REPS", 5))
+    n = max(n_requests, 200)
+    cc0 = engine.compile_count
+    t0 = time.perf_counter()
+    attr = engine.device_attribution(
+        reps=_env_int("SERVE_DEVATTR_REPS", 6))
+    attr_s = time.perf_counter() - t0
+    best_off = best_on = 0.0
+    keep = None
+    for rep in range(reps):
+        # paired legs: each rep reseeds so OFF and ON serve the
+        # IDENTICAL request-size stream (same rationale as the trace
+        # leg — a shared rng would bias the comparison)
+        m_off = ServeMetrics(registry=Registry(enabled=False))
+        off = mixed_stream(engine, n, max_wait_ms,
+                           np.random.RandomState(300 + rep),
+                           metrics=m_off)
+        best_off = max(best_off, off["throughput_req_per_s"])
+        m_on = ServeMetrics()
+        m_on.install_device_attribution(attr)
+        t = Tracer(max_spans=4 * n + 64)
+        on = mixed_stream(engine, n, max_wait_ms,
+                          np.random.RandomState(300 + rep),
+                          tracer=t, metrics=m_on,
+                          slo_classes=("interactive", "batch"))
+        if on["throughput_req_per_s"] >= best_on:
+            # keep the winning rep's snapshot + registry + tracer
+            # TOGETHER so every artifact field describes one run
+            best_on = on["throughput_req_per_s"]
+            keep = (on, m_on, t)
+    on_snap, m_on, tracer = keep
+    # the plane's standard interactive/batch pair + windows
+    # (utils.telemetry.DEFAULT_SLO_CLASSES — one definition, not a
+    # bench-local copy that could silently diverge)
+    slo = m_on.slo()
+    req_spans = [r for r in tracer.records() if r["name"] == "request"]
+    ids = [r["trace_id"] for r in req_spans]
+    spans_once = (len(ids) == n and len(set(ids)) == len(ids)
+                  and tracer.dropped == 0)
+    recompiles = engine.compile_count - cc0
+    overhead = best_off / best_on if best_on else float("inf")
+    section = {
+        "overhead_x": round(overhead, 3),
+        "reps": reps,
+        "requests_per_leg": n,
+        "plane_off_req_per_s": best_off,
+        "plane_on_req_per_s": best_on,
+        "plane_on_p50_ms": on_snap["p50_ms"],
+        "spans_exactly_once": spans_once,
+        "recompiles_during_telemetry": recompiles,
+        "registry_instruments": len(m_on.registry.instruments()),
+        "registry_points": m_on.registry.points_recorded(),
+        "slo": slo,
+        "device_attribution": attr,
+        "device_attribution_probe_s": round(attr_s, 3),
+        "latency_accounting": {
+            "seen": on_snap["latency_seen"],
+            "sampled": on_snap["latency_sampled"],
+            "reservoir_degraded": on_snap["reservoir_degraded"],
+        },
+    }
+    if not spans_once or recompiles:
+        # abort-grade, like parity: a lost/duplicated span or a
+        # recompile under the full plane must never emit green numbers
+        print(f"# serve_bench aborted: telemetry leg failed "
+              f"({json.dumps({k: section[k] for k in ('spans_exactly_once', 'recompiles_during_telemetry')})})",
+              file=sys.stderr)
+        raise SystemExit(1)
+    if overhead > 1.05:
+        print(f"# WARNING: telemetry plane measured {overhead:.3f}x "
+              "(> the 1.05 committed-artifact bound; "
+              "tools/check_bench_schema.py will refuse this artifact)",
+              file=sys.stderr)
+    return section
+
+
 def main():
     # shared prologue with bench.py (bench_common): re-apply
     # JAX_PLATFORMS over the container's sitecustomize, then the
@@ -834,6 +961,22 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
           f"{cold['artifact_export_s']}s, artifact compile_count "
           f"{cold['artifact_compile_count']})", file=sys.stderr)
 
+    # ISSUE 12: the unified-telemetry leg — the WHOLE plane (registry
+    # time series + per-class SLO family + tracing + device
+    # attribution) costed against the plane-off floor, paired; the
+    # exactly-once-span and zero-recompile pins stay abort-grade
+    t_tel0 = time.perf_counter()
+    telemetry = telemetry_bench(engine, n_requests=n_requests,
+                                max_wait_ms=max_wait_ms)
+    telemetry_s = time.perf_counter() - t_tel0
+    print(f"# telemetry plane: {telemetry['overhead_x']}x vs plane-off "
+          f"({telemetry['plane_on_req_per_s']} vs "
+          f"{telemetry['plane_off_req_per_s']} req/s; "
+          f"{telemetry['registry_instruments']} instruments, "
+          f"{telemetry['registry_points']} series points; device "
+          f"attribution: {telemetry['device_attribution']['source']})",
+          file=sys.stderr)
+
     # the zero-recompile pin now spans EVERY stream — untraced, traced,
     # and the rollout leg's swapped versions: tracing must not perturb
     # the shape discipline, and neither may a weight swap
@@ -874,12 +1017,12 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
 
     artifact = {
         "metric": "serve_bench",
-        # v4: the cold_start section (AOT artifact leg) and the
-        # chaos leg's mid-stream-swap pins join the v3 chaos and v2
-        # rollout sections in the contract — tools/
-        # check_bench_schema.py requires each from its version on
-        # (earlier artifacts are grandfathered by schema version)
-        "schema": "BENCH_SERVE.v4",
+        # v5: the telemetry_overhead section (unified telemetry
+        # plane) joins the v4 cold_start, v3 chaos, and v2 rollout
+        # sections in the contract — tools/check_bench_schema.py
+        # requires each from its version on (earlier artifacts are
+        # grandfathered by schema version)
+        "schema": "BENCH_SERVE.v5",
         "platform": platform,
         "engine": {
             "buckets": list(engine.buckets),
@@ -896,6 +1039,7 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
                    "rollout_s": round(loop_s, 3),
                    "chaos_s": round(chaos_s, 3),
                    "cold_start_s": round(cold_s, 3),
+                   "telemetry_s": round(telemetry_s, 3),
                    # None when BENCH_COMPILE_CACHE is unset (cold by
                    # construction); else dir + entry counts, so a
                    # warm-cache compile_warmup_s can never be read as
@@ -907,6 +1051,7 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
         "rollout": rollout,
         "chaos": chaos,
         "cold_start": cold,
+        "telemetry_overhead": telemetry,
         "trace": {
             "request_spans": len(req_spans),
             "unique_request_ids": len(set(ids)),
@@ -931,6 +1076,22 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
     with open(out_path, "w") as f:
         json.dump(artifact, f, indent=1)
     print(f"# artifact -> {out_path}", file=sys.stderr)
+
+    # the telemetry-plane line (FIRST of the leg lines, so the
+    # chaos/rollout/cold-start/trace line positions the contract test
+    # pins are unmoved; headline still LAST): what the whole
+    # observability plane costs, and whether the device split landed
+    print(json.dumps({
+        "metric": "serve_telemetry_overhead",
+        "value": telemetry["overhead_x"],
+        "unit": "x-vs-plane-off",
+        "plane_on_req_per_s": telemetry["plane_on_req_per_s"],
+        "plane_off_req_per_s": telemetry["plane_off_req_per_s"],
+        "registry_points": telemetry["registry_points"],
+        "slo_classes": len(telemetry["slo"]["classes"]),
+        "device_attribution": telemetry["device_attribution"]["source"],
+        "platform": platform,
+    }))
 
     # the chaos-leg line (before the headline, which stays LAST): the
     # failover evidence — kills fired, requeues landed, nothing lost,

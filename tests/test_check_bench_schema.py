@@ -260,6 +260,90 @@ def test_serve_v4_rejects_midstream_swap_drift(tmp_path):
         _write(tmp_path, "BENCH_SERVE_r09.json", v3)) == []
 
 
+GOOD_TELEMETRY = {
+    "overhead_x": 0.99, "reps": 5, "requests_per_leg": 200,
+    "plane_off_req_per_s": 5000.0, "plane_on_req_per_s": 5050.0,
+    "spans_exactly_once": True, "recompiles_during_telemetry": 0,
+    "registry_instruments": 17, "registry_points": 1200,
+    "slo": {"schema": "SLO.v1", "classes": {
+        "interactive": {"objective": 0.99, "threshold_ms": 50.0,
+                        "windows": {"60s": {"total": 100, "good": 99,
+                                            "attainment": 0.99,
+                                            "burn_rate": 1.0}}}}},
+    "device_attribution": {"source": "none",
+                           "reason": "profiler capture holds no "
+                                     "device lane (CPU backend)"},
+}
+
+
+def _serve_art_v5(**extra):
+    art = _serve_art(schema="BENCH_SERVE.v5",
+                     chaos=dict(GOOD_CHAOS_V4),
+                     cold_start=dict(GOOD_COLD),
+                     telemetry_overhead=dict(GOOD_TELEMETRY))
+    art.update(extra)
+    return art
+
+
+def test_serve_v5_requires_telemetry_section(tmp_path):
+    """From schema v5 on, the unified-telemetry leg's
+    'telemetry_overhead' section is contract; v4 artifacts predate it
+    and stay valid."""
+    assert cbs.validate_file(
+        _write(tmp_path, "BENCH_SERVE_r09.json", _serve_art_v5())) == []
+    art = _serve_art_v5()
+    del art["telemetry_overhead"]
+    errs = cbs.validate_file(_write(tmp_path, "BENCH_SERVE_r09.json",
+                                    art))
+    assert any("'telemetry_overhead' section" in e for e in errs)
+    # v4 stays valid without the section (pre-ISSUE-12 shape)
+    v4 = _serve_art_v4()
+    assert cbs.validate_file(
+        _write(tmp_path, "BENCH_SERVE_r09.json", v4)) == []
+
+
+def test_serve_v5_rejects_telemetry_drift(tmp_path):
+    for key, bad in (("overhead_x", None), ("overhead_x", 0),
+                     ("reps", 0), ("plane_on_req_per_s", None),
+                     ("plane_off_req_per_s", 0),
+                     ("spans_exactly_once", False),
+                     ("recompiles_during_telemetry", 2),
+                     ("slo", {}), ("slo", {"classes": {}}),
+                     ("device_attribution", None),
+                     ("device_attribution", {})):
+        tel = dict(GOOD_TELEMETRY, **{key: bad})
+        p = _write(tmp_path, "BENCH_SERVE_r09.json",
+                   _serve_art_v5(telemetry_overhead=tel))
+        assert cbs.validate_file(p), \
+            f"accepted broken telemetry {key}={bad}"
+    # the <=5% bound IS the leg's claim: a costlier plane in a
+    # committed artifact must not land green
+    p = _write(tmp_path, "BENCH_SERVE_r09.json", _serve_art_v5(
+        telemetry_overhead=dict(GOOD_TELEMETRY, overhead_x=1.2)))
+    assert any("1.05 bound" in e for e in cbs.validate_file(p))
+    # a non-profiler attribution must name its reason (the honest CPU
+    # fallback shape)...
+    p = _write(tmp_path, "BENCH_SERVE_r09.json", _serve_art_v5(
+        telemetry_overhead=dict(GOOD_TELEMETRY,
+                                device_attribution={"source": "none"})))
+    assert any("reason" in e for e in cbs.validate_file(p))
+    # ...and a profiler one must carry the split fields
+    p = _write(tmp_path, "BENCH_SERVE_r09.json", _serve_art_v5(
+        telemetry_overhead=dict(
+            GOOD_TELEMETRY,
+            device_attribution={"source": "profiler"})))
+    errs = cbs.validate_file(p)
+    assert any("device_compute_s" in e for e in errs)
+    assert any("compute_fraction" in e for e in errs)
+    # a complete profiler attribution validates
+    good_attr = {"source": "profiler", "device_compute_s": 0.04,
+                 "xla_queue_s": 0.01, "compute_fraction": 0.8}
+    p = _write(tmp_path, "BENCH_SERVE_r09.json", _serve_art_v5(
+        telemetry_overhead=dict(GOOD_TELEMETRY,
+                                device_attribution=good_attr)))
+    assert cbs.validate_file(p) == []
+
+
 def test_rejects_multichip_ok_rc_disagreement(tmp_path):
     p = _write(tmp_path, "MULTICHIP_r09.json",
                {"n_devices": 8, "rc": 124, "ok": True, "tail": "OK"})

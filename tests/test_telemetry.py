@@ -1,0 +1,587 @@
+"""utils/telemetry.py — the unified telemetry plane (ISSUE 12).
+
+Five layers, all tier-1:
+
+- **Registry semantics**: typed instruments with label sets, idempotent
+  re-request, kind conflicts refused, exactly-once counts under
+  threaded increments (the thread-safety contract the serving worker
+  and publisher threads lean on), ring-buffer wraparound keeping the
+  NEWEST tail.
+- **Windowed math**: counter rates and SLO attainment/burn-rate against
+  hand-computed fixtures on an injected synthetic clock — the
+  admission/autoscaling signal (ROADMAP direction 4) must be exact
+  arithmetic, not vibes.
+- **Exporters**: Prometheus text and OTLP-shaped JSON round-trips,
+  including a REAL traced training run through ``tools/obs_export.py``
+  (the acceptance criterion), and the serve-side per-class latency
+  family driven by real ``ServingService`` traffic.
+- **Device-time attribution**: the Chrome-trace parser against a
+  synthetic capture with and without device lanes, and the graceful
+  CPU fallback of a real ``jax.profiler`` probe (this suite runs on
+  JAX_PLATFORMS=cpu, where the capture has no device lane by
+  construction).
+- **Trace-context propagation** (the DCN-hop satellite): inject/
+  extract round-trips over both carrier spellings, malformed carriers
+  loud.
+"""
+
+import glob
+import gzip
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from fedamw_tpu.utils import telemetry as T  # noqa: E402
+from fedamw_tpu.utils import trace as trace_mod  # noqa: E402
+
+pytestmark = pytest.mark.telemetry
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture()
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture()
+def reg(clock):
+    return T.Registry(clock=clock)
+
+
+# -- registry semantics -----------------------------------------------
+
+def test_instrument_identity_and_kind_conflicts(reg):
+    a = reg.counter("reqs_total", "help", labels={"class": "x"})
+    b = reg.counter("reqs_total", labels={"class": "x"})
+    assert a is b  # idempotent: callers never need to cache children
+    c = reg.counter("reqs_total", labels={"class": "y"})
+    assert c is not a
+    with pytest.raises(TypeError, match="one name, one type"):
+        reg.gauge("reqs_total")
+    with pytest.raises(ValueError, match="bad instrument name"):
+        reg.counter("bad name")
+    h = reg.histogram("lat", bounds=(0.1, 1.0))
+    assert h.bounds == (0.1, 1.0)
+    with pytest.raises(ValueError, match="different bounds"):
+        reg.histogram("lat", labels={"class": "x"}, bounds=(0.5,))
+    with pytest.raises(ValueError, match="cannot decrease"):
+        a.inc(-1)
+
+
+def test_counter_exactly_once_under_threaded_increments(reg):
+    """The concurrency pin: N threads x M increments land exactly
+    N*M — on the cumulative value AND on the retained series tail."""
+    c = reg.counter("hits_total")
+    n_threads, per = 8, 500
+
+    def worker():
+        for _ in range(per):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * per
+    # the ring holds the newest tail of CUMULATIVE values; the last
+    # sample must equal the final count (no lost update anywhere)
+    items = c.series.items()
+    assert items[-1][1] == n_threads * per
+    assert len(items) + c.series.dropped == n_threads * per
+
+
+def test_ring_buffer_wraparound_keeps_newest(clock):
+    ts = T.TimeSeries(capacity=4)
+    for i in range(10):
+        ts.append(float(i), float(i * 10))
+    assert len(ts) == 4
+    assert ts.dropped == 6
+    assert ts.items() == [(6.0, 60.0), (7.0, 70.0), (8.0, 80.0),
+                          (9.0, 90.0)]
+    assert ts.window(8.0) == [(8.0, 80.0), (9.0, 90.0)]
+    with pytest.raises(ValueError):
+        T.TimeSeries(capacity=0)
+
+
+def test_disabled_registry_keeps_values_skips_series(clock):
+    reg = T.Registry(enabled=False, clock=clock)
+    c = reg.counter("x_total")
+    g = reg.gauge("g")
+    h = reg.histogram("h")
+    c.inc(3)
+    g.set(7)
+    h.observe(0.5)
+    assert c.value == 3 and g.value == 7 and h.count == 1
+    assert reg.points_recorded() == 0  # the cheap plane-off mode
+    assert h.percentile(50) is None  # series-backed reads degrade
+
+
+# -- windowed math ----------------------------------------------------
+
+def test_counter_rate_hand_computed(reg, clock):
+    c = reg.counter("ticks_total")
+    for i in range(10):
+        clock.t = float(i)  # one inc per second, t = 0..9
+        c.inc()
+    # window (4, 9]: cumulative went 5 -> 10 over 5s
+    assert c.rate(5.0, now=9.0) == pytest.approx(1.0)
+    # a wider window than the series' life: base is an honest zero
+    assert c.rate(100.0, now=9.0) == pytest.approx(10 / 100.0)
+
+
+def test_gauge_window_stats(reg, clock):
+    g = reg.gauge("load")
+    for i, v in enumerate((1.0, 5.0, 3.0)):
+        clock.t = float(i)
+        g.set(v)
+    s = g.window_stats(10.0, now=2.0)
+    assert s == {"n": 3, "min": 1.0, "mean": 3.0, "max": 5.0,
+                 "last": 3.0}
+    assert g.window_stats(0.5, now=10.0)["n"] == 0
+
+
+def test_slo_attainment_and_burn_rate_hand_computed(reg, clock):
+    """Fixture: 100 interactive requests in the last 50s, 10 of them
+    over the 50ms threshold -> attainment 0.90, error rate 0.10,
+    budget 0.01 (objective 0.99) -> burn rate 10.0 exactly."""
+    h = reg.histogram("serve_request_latency_seconds",
+                      labels={"class": "interactive"})
+    for i in range(100):
+        clock.t = 50.0 + i * 0.5  # t in [50, 99.5]
+        h.observe(0.2 if i % 10 == 0 else 0.01)
+    ev = T.SloEvaluator(
+        reg, classes=(T.SloClass("interactive", threshold_ms=50.0,
+                                 objective=0.99),),
+        windows_s=(60.0, 20.0))
+    out = ev.evaluate(now=100.0)
+    w60 = out["classes"]["interactive"]["windows"]["60s"]
+    assert w60["total"] == 100 and w60["good"] == 90
+    assert w60["attainment"] == pytest.approx(0.9)
+    assert w60["burn_rate"] == pytest.approx(10.0)
+    # the 20s window holds samples with t >= 80: i in [60, 99], four
+    # of which (60, 70, 80, 90) are slow -> 36/40 good
+    w20 = out["classes"]["interactive"]["windows"]["20s"]
+    assert w20["total"] == 40 and w20["good"] == 36
+    assert w20["burn_rate"] == pytest.approx((4 / 40) / 0.01)
+
+
+def test_slo_empty_window_is_no_data_not_perfect(reg):
+    ev = T.SloEvaluator(reg, classes=(T.SloClass("batch", 500.0,
+                                                 objective=0.95),))
+    out = ev.evaluate(now=1000.0)
+    w = out["classes"]["batch"]["windows"]["60s"]
+    # no traffic must read as "no data" — an autoscaler seeing
+    # attainment 1.0 on an idle class would never scale from zero
+    assert w["total"] == 0
+    assert w["attainment"] is None and w["burn_rate"] is None
+    # and the pure read minted NO phantom family into the registry
+    # (evaluate uses the non-creating lookup)
+    assert reg.instruments() == []
+    assert reg.lookup("serve_request_latency_seconds",
+                      labels={"class": "batch"}) is None
+
+
+def test_slo_class_validation():
+    with pytest.raises(ValueError, match="objective"):
+        T.SloClass("x", threshold_ms=50.0, objective=1.0)
+    with pytest.raises(ValueError, match="threshold_ms"):
+        T.SloClass("x", threshold_ms=0.0)
+    with pytest.raises(ValueError, match="at least one"):
+        T.SloEvaluator(T.Registry(), classes=())
+
+
+# -- exporters --------------------------------------------------------
+
+def _populated_registry(clock):
+    reg = T.Registry(clock=clock)
+    clock.t = 1.0
+    reg.counter("reqs_total", "requests", labels={"class": "a"}).inc(5)
+    reg.gauge("depth", "queue depth").set(3.0)
+    h = reg.histogram("lat_seconds", "latency", bounds=(0.01, 0.1))
+    h.observe(0.005)
+    h.observe(0.05)
+    h.observe(5.0)
+    return reg
+
+
+def test_prometheus_render_round_trip(clock):
+    reg = _populated_registry(clock)
+    text = T.render_prometheus(reg)
+    assert "# TYPE reqs_total counter" in text
+    assert "# HELP depth queue depth" in text
+    parsed = T.parse_prometheus(text)
+    assert parsed['reqs_total{class="a"}'] == 5.0
+    assert parsed["depth"] == 3.0
+    # histogram triplet with CUMULATIVE buckets and a +Inf tail
+    assert parsed['lat_seconds_bucket{le="0.01"}'] == 1.0
+    assert parsed['lat_seconds_bucket{le="0.1"}'] == 2.0
+    assert parsed['lat_seconds_bucket{le="+Inf"}'] == 3.0
+    assert parsed["lat_seconds_count"] == 3.0
+    assert parsed["lat_seconds_sum"] == pytest.approx(5.055)
+    # the dump dict renders identically (the offline CLI path)
+    assert T.render_prometheus(reg.dump()) == text
+
+
+def test_registry_otlp_shape_and_anchor(clock):
+    reg = _populated_registry(clock)
+    doc = T.registry_to_otlp(reg)
+    metrics = {m["name"]: m
+               for m in doc["resourceMetrics"][0]["scopeMetrics"][0]
+               ["metrics"]}
+    assert set(metrics) == {"reqs_total", "depth", "lat_seconds"}
+    assert metrics["reqs_total"]["sum"]["isMonotonic"] is True
+    pt = metrics["reqs_total"]["sum"]["dataPoints"][0]
+    assert pt["asDouble"] == 5.0
+    assert pt["attributes"] == [
+        {"key": "class", "value": {"stringValue": "a"}}]
+    # anchor mapping: sample at mono t=1.0, anchor captured at
+    # clock()=0 when the registry was built -> unix_s + 1.0
+    want_ns = int((reg.anchor["unix_s"] + 1.0) * 1e9)
+    assert abs(int(pt["timeUnixNano"]) - want_ns) < 2
+    hist = metrics["lat_seconds"]["histogram"]["dataPoints"][0]
+    assert hist["count"] == "3"
+    assert hist["bucketCounts"] == ["1", "1", "1"]
+    assert hist["explicitBounds"] == [0.01, 0.1]
+
+
+def test_non_finite_values_export_instead_of_crashing(clock):
+    """A diverging run's loss gauge IS NaN; both exporters must render
+    it (Prometheus literal NaN/+Inf; proto3-JSON string spellings) —
+    a crash here would lose the run's results to its own telemetry."""
+    reg = T.Registry(clock=clock)
+    reg.gauge("loss").set(float("nan"))
+    reg.gauge("ratio").set(float("inf"))
+    text = T.render_prometheus(reg)
+    parsed_lines = dict(ln.rsplit(None, 1) for ln in text.splitlines()
+                        if not ln.startswith("#") and ln)
+    assert parsed_lines["loss"] == "NaN"
+    assert parsed_lines["ratio"] == "+Inf"
+    doc = T.registry_to_otlp(reg)
+    json.dumps(doc, allow_nan=False)  # strictly valid JSON
+    pts = {m["name"]: m["gauge"]["dataPoints"][0]["asDouble"]
+           for m in doc["resourceMetrics"][0]["scopeMetrics"][0]
+           ["metrics"]}
+    assert pts == {"loss": "NaN", "ratio": "Infinity"}
+    span = {"name": "x", "kind": "span", "trace_id": "t-1",
+            "span_id": "s-1", "parent_id": None, "start_s": 0.0,
+            "dur_s": 0.1, "attrs": {"loss": float("nan")}}
+    json.dumps(T.spans_to_otlp([span]), allow_nan=False)
+
+
+def test_registry_otlp_merges_label_sets_per_family(clock):
+    reg = T.Registry(clock=clock)
+    reg.counter("reqs_total", labels={"class": "a"}).inc(1)
+    reg.counter("reqs_total", labels={"class": "b"}).inc(2)
+    doc = T.registry_to_otlp(reg)
+    metrics = doc["resourceMetrics"][0]["scopeMetrics"][0]["metrics"]
+    # ONE metric entry per family; the label sets become dataPoints
+    assert [m["name"] for m in metrics] == ["reqs_total"]
+    pts = metrics[0]["sum"]["dataPoints"]
+    got = {pt["attributes"][0]["value"]["stringValue"]: pt["asDouble"]
+           for pt in pts}
+    assert got == {"a": 1.0, "b": 2.0}
+
+
+def test_spans_otlp_ids_and_parenting():
+    spans = [
+        {"name": "request", "kind": "span", "trace_id": "req-7",
+         "span_id": "s-1", "parent_id": None, "start_s": 10.0,
+         "dur_s": 0.5, "attrs": {"rows": 4, "ok": True, "q": 1.5}},
+        {"name": "engine_retry", "kind": "annotation",
+         "trace_id": "req-7", "span_id": "s-2", "parent_id": "s-1",
+         "start_s": 10.2, "dur_s": 0.0, "attrs": {}},
+    ]
+    doc = T.spans_to_otlp(spans, anchor={"unix_s": 100.0,
+                                         "mono_s": 0.0})
+    out = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert len(out) == 2
+    root, note = out
+    assert len(root["traceId"]) == 32 and len(root["spanId"]) == 16
+    assert root["traceId"] == note["traceId"]  # same trace, same id
+    assert note["parentSpanId"] == root["spanId"]  # hashed parenting
+    assert root["startTimeUnixNano"] == str(int(110.0 * 1e9))
+    assert root["endTimeUnixNano"] == str(int(110.5 * 1e9))
+    attrs = {a["key"]: a["value"] for a in root["attributes"]}
+    assert attrs["rows"] == {"intValue": "4"}
+    assert attrs["ok"] == {"boolValue": True}
+    assert attrs["q"] == {"doubleValue": 1.5}
+    assert attrs["trace_id_raw"] == {"stringValue": "req-7"}
+    note_attrs = {a["key"]: a["value"] for a in note["attributes"]}
+    assert note_attrs["kind_raw"] == {"stringValue": "annotation"}
+
+
+def test_obs_export_cli_round_trips_a_real_traced_run(tmp_path):
+    """The acceptance criterion: a REAL traced (and telemetered)
+    training run exports through tools/obs_export.py and every span
+    id survives the OTLP conversion exactly once."""
+    from fedamw_tpu.algorithms import FedAvg, prepare_setup
+    from fedamw_tpu.data import FederatedDataset, dirichlet_partition
+    from fedamw_tpu.data.synthetic import synthetic_classification
+
+    import tools.obs_export as ox
+
+    X, y, Xt, yt = synthetic_classification(256, 8, 2, seed=3)
+    parts, _ = dirichlet_partition(y, 4, alpha=0.5, seed=2020,
+                                   min_size=0)
+    ds = FederatedDataset(
+        name="tel", task_type="classification", num_classes=2, d=8,
+        X_train=X, y_train=y, X_test=Xt, y_test=yt, parts=parts,
+        source="synthetic")
+    setup = prepare_setup(ds, D=16, kernel_par=0.1, seed=100,
+                          rng=np.random.RandomState(100))
+    rounds = 3
+    tracer = trace_mod.configure()
+    registry = T.reset_registry()
+    try:
+        FedAvg(setup, lr=0.5, epoch=1, batch_size=32, round=rounds,
+               seed=0, lr_mode="constant")
+    finally:
+        trace_mod.configure(enabled=False)
+    trace_path = str(tmp_path / "run_trace.jsonl")
+    n_spans = tracer.export_jsonl(trace_path)
+    assert n_spans >= rounds + 1  # the scan span + one per round
+    dump_path = str(tmp_path / "run_telemetry.json")
+    with open(dump_path, "w") as f:
+        json.dump(registry.dump(), f)
+    out_path = str(tmp_path / "run_otlp.json")
+    assert ox.main([trace_path, dump_path, "-o", out_path]) == 0
+    with open(out_path) as f:
+        doc = json.load(f)
+    otlp_spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    raw_ids = [
+        next(a["value"]["stringValue"] for a in s["attributes"]
+             if a["key"] == "id_raw")
+        for s in otlp_spans]
+    want_ids = [r["span_id"] for r in tracer.records()]
+    assert sorted(raw_ids) == sorted(want_ids)  # exactly once, all
+    # round spans hang under the scan span after id hashing
+    by_name = {}
+    for s in otlp_spans:
+        by_name.setdefault(s["name"], []).append(s)
+    scan = by_name["train_scan"][0]
+    assert all(r["parentSpanId"] == scan["spanId"]
+               for r in by_name["round"])
+    # the telemetry side came through with the per-round loss series
+    names = {m["name"]
+             for m in doc["resourceMetrics"][0]["scopeMetrics"][0]
+             ["metrics"]}
+    assert {"fed_train_loss", "fed_test_acc"} <= names
+    # header anchor -> unix-epoch timeline (not the monotonic raw)
+    assert int(otlp_spans[0]["startTimeUnixNano"]) > 10**17
+    # prometheus mode renders the registry and refuses the trace
+    assert ox.main([dump_path, "--format", "prometheus",
+                    "-o", str(tmp_path / "m.prom")]) == 0
+    assert "fed_train_loss" in (tmp_path / "m.prom").read_text()
+    assert ox.main([trace_path, "--format", "prometheus"]) == 1
+
+
+def test_serve_metrics_slo_family_via_real_service():
+    """The serving wire-up: slo_class on submit lands the request in
+    the labeled latency family, and ServeMetrics.slo() evaluates it."""
+    from fedamw_tpu.serving import ServeMetrics, ServingEngine, \
+        ServingService
+
+    eng = ServingEngine({"w": np.zeros((2, 8), np.float32)},
+                        buckets=(1, 4))
+    eng.warmup()
+    m = ServeMetrics()
+    with ServingService(eng, metrics=m) as svc:
+        for i in range(10):
+            svc.submit(np.zeros(8, np.float32),
+                       slo_class="interactive" if i % 2 else "batch"
+                       ).result(timeout=30)
+    slo = m.slo(windows_s=(300.0,))
+    tot = {k: v["windows"]["300s"]["total"]
+           for k, v in slo["classes"].items()}
+    assert tot == {"interactive": 5, "batch": 5}
+    snap = m.snapshot(eng)
+    assert snap["requests"] == 10
+    assert snap["latency_seen"] == 10
+    assert snap["reservoir_degraded"] is False
+    assert snap["device_attribution"] is None
+    # the registry carries the re-based counters as series
+    assert m.registry.snapshot()["serve_requests_total"] == 10.0
+
+
+def test_latency_histogram_reservoir_honesty():
+    from fedamw_tpu.serving import LatencyHistogram
+
+    h = LatencyHistogram(max_samples=10)
+    for i in range(10):
+        h.record(0.001 * (i + 1))
+    assert h.accounting() == {"seen": 10, "sampled": 10,
+                              "reservoir_degraded": False}
+    h.record(0.5)
+    acct = h.accounting()
+    assert acct == {"seen": 11, "sampled": 10,
+                    "reservoir_degraded": True}
+    assert h.count == 11 and h.sampled == 10 and h.degraded is True
+
+
+# -- device-time attribution ------------------------------------------
+
+def _write_capture(tmp_path, events):
+    d = tmp_path / "plugins" / "profile" / "2026_01_01"
+    d.mkdir(parents=True)
+    with gzip.open(str(d / "host.trace.json.gz"), "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return str(tmp_path)
+
+
+def test_parse_profiler_trace_device_lanes(tmp_path):
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "pid": 2, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "pid": 1, "name": "PjitFunction", "dur": 500.0},
+        {"ph": "X", "pid": 2, "name": "fusion.1", "dur": 120.0},
+        {"ph": "X", "pid": 2, "name": "fusion.2", "dur": 80.0},
+    ]
+    parsed = T.parse_profiler_trace(_write_capture(tmp_path, events))
+    # only the device lane counts: 200us of op time, host excluded
+    assert parsed == {"device_busy_s": pytest.approx(200e-6),
+                      "device_events": 2, "device_lanes": 1}
+
+
+def test_parse_profiler_trace_host_only_is_none(tmp_path):
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "X", "pid": 1, "name": "TfrtCpuExecutable::Execute",
+         "dur": 300.0},
+    ]
+    assert T.parse_profiler_trace(
+        _write_capture(tmp_path, events)) is None
+    assert T.parse_profiler_trace(str(tmp_path / "empty")) is None
+
+
+def test_attribute_device_time_cpu_fallback_real_profiler():
+    """The tested graceful fallback: a REAL jax.profiler capture on
+    the CPU backend yields no device lane, and attribution says so
+    instead of guessing."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: (x @ x.T).sum())
+    x = jnp.ones((32, 32))
+    f(x).block_until_ready()
+
+    import time as _time
+
+    def dispatch():
+        t0 = _time.perf_counter()
+        f(x).block_until_ready()
+        return _time.perf_counter() - t0
+
+    attr = T.attribute_device_time(dispatch, reps=2)
+    assert attr["source"] == "none"
+    assert "no device lane" in attr["reason"]
+    assert attr["dispatch_s"] > 0
+    assert attr["reps"] == 2
+
+
+def test_attribute_device_time_profiler_failure_degrades():
+    def dispatch():
+        raise RuntimeError("synthetic dispatch failure")
+
+    attr = T.attribute_device_time(dispatch, reps=1)
+    assert attr["source"] == "none"
+    assert "RuntimeError" in attr["reason"]
+
+
+def test_metrics_device_split_from_profiler_attribution():
+    """With a profiler-sourced attribution installed, the snapshot's
+    device family grows the compute/queue split at the measured
+    fraction — and without one, the split keys are absent."""
+    from fedamw_tpu.serving import ServeMetrics
+
+    m = ServeMetrics()
+    m.record_batch(n_requests=2, n_rows=2, latencies=[0.01, 0.02],
+                   stage_seconds={"queue": [0.001, 0.001],
+                                  "pad": 0.002, "device": 0.008})
+    snap = m.snapshot()
+    assert "device_compute_p50_ms" not in snap
+    m.install_device_attribution({
+        "source": "profiler", "compute_fraction": 0.75,
+        "device_compute_s": 0.06, "xla_queue_s": 0.02})
+    snap = m.snapshot()
+    assert snap["device_attribution"]["source"] == "profiler"
+    assert snap["device_compute_p50_ms"] == pytest.approx(
+        snap["device_p50_ms"] * 0.75, rel=1e-6)
+    assert snap["xla_queue_p50_ms"] == pytest.approx(
+        snap["device_p50_ms"] * 0.25, rel=1e-6)
+
+
+# -- trace-context propagation ----------------------------------------
+
+def test_trace_context_round_trip_dict_and_header():
+    carrier = trace_mod.inject_context("req-42", span_id="s-7")
+    assert carrier == {"schema": "TRACECTX.v1", "trace_id": "req-42",
+                      "parent_id": "s-7"}
+    json.dumps(carrier)  # serializable by construction
+    ctx = trace_mod.extract_context(carrier)
+    assert ctx.trace_id == "req-42" and ctx.parent_id == "s-7"
+    header = trace_mod.format_context(carrier)
+    assert header == "TRACECTX.v1;req-42;s-7"
+    assert trace_mod.extract_context(header) == ctx
+    # rootless carrier (no current span): parent collapses to None
+    root = trace_mod.inject_context("req-9")
+    assert trace_mod.extract_context(
+        trace_mod.format_context(root)).parent_id is None
+
+
+def test_trace_context_remote_side_lands_one_trace():
+    """The DCN-hop shape: the remote process emits its span under the
+    extracted context, and both sides share one trace id."""
+    local = trace_mod.Tracer()
+    rid = local.new_id("req")
+    with local.span("dispatch", rid) as sp:
+        pass
+    carrier = trace_mod.format_context(
+        trace_mod.inject_context(rid, span_id=sp.span_id))
+    remote = trace_mod.Tracer()  # a different process's tracer
+    ctx = trace_mod.extract_context(carrier)
+    with remote.span("remote_serve", ctx.trace_id,
+                     parent_id=ctx.parent_id):
+        pass
+    rec = remote.records()[0]
+    assert rec["trace_id"] == rid
+    assert rec["parent_id"] == sp.span_id
+
+
+def test_trace_context_malformed_is_loud():
+    for bad in ("TRACECTX.v1;only-two", "WRONG.v1;a;b", "", "a;b;c;d",
+                {"schema": "TRACECTX.v1"}, {"schema": "nope"}, 42):
+        with pytest.raises(ValueError):
+            trace_mod.extract_context(bad)
+    with pytest.raises(ValueError):
+        trace_mod.inject_context("")
+    with pytest.raises(ValueError):
+        trace_mod.inject_context("has;separator")
+
+
+def test_export_header_carries_wall_anchor(tmp_path):
+    tr = trace_mod.Tracer()
+    tr.emit("x", tr.new_id("t"), 1.0, 0.1)
+    path = str(tmp_path / "t.jsonl")
+    tr.export_jsonl(path)
+    header, spans = trace_mod.read_jsonl(path)
+    assert header["anchor_unix_s"] > 10**9  # wall clock, header-only
+    assert header["anchor_mono_s"] >= 0
+    assert all("anchor_unix_s" not in s for s in spans)

@@ -38,7 +38,15 @@ Three artifact families, three rule sets:
   present and timed (compile-warmup vs artifact load), the load
   path's ``artifact_compile_count == 0``, plus the chaos section's
   mid-stream-swap pins (positive ``post_swap_requests``,
-  ``post_swap_version_ok`` true).
+  ``post_swap_version_ok`` true). From schema v5 on, the
+  ``telemetry_overhead`` section (the ISSUE 12 unified telemetry
+  plane) is required too: the PAIRED plane-on vs plane-off throughput
+  with ``overhead_x <= 1.05`` (the <=5% bound is the leg's whole
+  claim — an artifact recording a costlier plane must not land
+  green), the exactly-once-span and zero-recompile pins re-checked,
+  an SLO evaluation with at least one class, and a
+  ``device_attribution`` record that either carries the profiler
+  split fields or names WHY it has none (the CPU fallback).
 - ``MULTICHIP_rNN.json`` — the dryrun wrapper: ``n_devices``/``rc``/
   ``ok``/``tail``, with ``ok`` true iff ``rc == 0`` (a disagreeing
   pair is exactly the silent-green failure this tool exists to catch).
@@ -167,6 +175,7 @@ def check_serve_artifact(art: dict, name: str) -> list[str]:
     errs.extend(_check_rollout_section(art, schema))
     errs.extend(_check_chaos_section(art, schema))
     errs.extend(_check_cold_start_section(art, schema))
+    errs.extend(_check_telemetry_section(art, schema))
     return errs
 
 
@@ -325,6 +334,79 @@ def _check_cold_start_section(art: dict, schema: str) -> list[str]:
             errs.append("chaos: 'post_swap_version_ok' must be true "
                         "(every post-swap span carries the new "
                         "model_version)")
+    return errs
+
+
+def _check_telemetry_section(art: dict, schema: str) -> list[str]:
+    """The v5+ ``telemetry_overhead`` contract (the ISSUE 12 unified
+    telemetry plane): the PAIRED plane-on/plane-off comparison must be
+    present, positive, and within the <=5% bound the leg exists to
+    prove; the exactly-once-span and zero-recompile pins are
+    re-checked at the gate (a hand-edited artifact must not land
+    green); the SLO evaluation must cover at least one class; and the
+    device-attribution record must either carry the profiler split
+    fields or name why it has none (the graceful CPU fallback).
+    Earlier schema versions predate the leg and are grandfathered."""
+    if not schema.startswith("BENCH_SERVE."):
+        return []  # family error already reported by the caller
+    version = _schema_version(schema)
+    if version is None:
+        return []  # the rollout check already reported it
+    if version < 5:
+        return []
+    tel = art.get("telemetry_overhead")
+    if not isinstance(tel, dict):
+        return ["schema v5+ requires a 'telemetry_overhead' section "
+                "(the unified telemetry plane leg)"]
+    errs = []
+    ox = tel.get("overhead_x")
+    if not isinstance(ox, (int, float)) or ox <= 0:
+        errs.append("telemetry_overhead: 'overhead_x' must be a "
+                    "positive number")
+    elif ox > 1.05:
+        errs.append(f"telemetry_overhead: overhead_x={ox} exceeds the "
+                    "1.05 bound — the plane's whole claim is <=5% "
+                    "cost; a costlier capture must not land green")
+    if not isinstance(tel.get("reps"), int) or tel["reps"] < 1:
+        errs.append("telemetry_overhead: 'reps' must be a positive "
+                    "int (the paired best-of estimator's sample size)")
+    for key in ("plane_on_req_per_s", "plane_off_req_per_s"):
+        if not isinstance(tel.get(key), (int, float)) or tel[key] <= 0:
+            errs.append(f"telemetry_overhead: missing positive "
+                        f"numeric {key!r} (both paired legs must be "
+                        "measured)")
+    if tel.get("spans_exactly_once") is not True:
+        errs.append("telemetry_overhead: 'spans_exactly_once' must be "
+                    "true (the exactly-once pin stays abort-grade "
+                    "under the full plane)")
+    if tel.get("recompiles_during_telemetry") != 0:
+        errs.append("telemetry_overhead: recompiles_during_telemetry="
+                    f"{tel.get('recompiles_during_telemetry')!r} — "
+                    "observability must never perturb the shape "
+                    "discipline")
+    slo = tel.get("slo")
+    if not isinstance(slo, dict) or not isinstance(
+            slo.get("classes"), dict) or not slo["classes"]:
+        errs.append("telemetry_overhead: 'slo' must record a per-class "
+                    "evaluation with at least one class")
+    attr = tel.get("device_attribution")
+    if not isinstance(attr, dict) or "source" not in attr:
+        errs.append("telemetry_overhead: 'device_attribution' must be "
+                    "a record naming its 'source'")
+    elif attr["source"] == "profiler":
+        # the split landed: its fields are contract
+        for key in ("device_compute_s", "xla_queue_s"):
+            if not isinstance(attr.get(key), (int, float)):
+                errs.append(f"telemetry_overhead: profiler attribution "
+                            f"missing numeric {key!r}")
+        frac = attr.get("compute_fraction")
+        if not isinstance(frac, (int, float)) or not 0 <= frac <= 1:
+            errs.append("telemetry_overhead: profiler attribution "
+                        "'compute_fraction' must be in [0, 1]")
+    elif not attr.get("reason"):
+        errs.append("telemetry_overhead: a non-profiler "
+                    "device_attribution must carry its 'reason' (the "
+                    "honest CPU-fallback shape)")
     return errs
 
 

@@ -384,6 +384,16 @@ def _acquires_lock(fn, lock_src: str) -> bool:
                         return True
                 except Exception:
                     continue
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "acquire":
+            # the .acquire() spelling re-acquires just as hard as a
+            # with-block does
+            try:
+                if ast.unparse(node.func.value) == lock_src:
+                    return True
+            except Exception:
+                continue
     return False
 
 
@@ -407,57 +417,195 @@ def rule_locks(modules) -> list[tuple]:
                         lock_src = tail
                     out.extend(_lint_lock_body(
                         mod, fi, node, lock_src, kind, blocking))
+            out.extend(_lint_acquire_regions(mod, fi, types, blocking))
+    return out
+
+
+def _stmt_lists(fn_node):
+    """Every ordered statement list in a function (bodies, else/finally
+    arms) — where an ``.acquire()``'s held region is a SUFFIX, not a
+    subtree."""
+    for node in ast.walk(fn_node):
+        for field in ("body", "orelse", "finalbody"):
+            stmts = getattr(node, field, None)
+            if isinstance(stmts, list) and stmts:
+                yield stmts
+        if isinstance(node, ast.Try):
+            for handler in node.handlers:
+                if handler.body:
+                    yield handler.body
+
+
+def _acquire_stmt(stmt, mod):
+    """``(call_node, lock_src, tail)`` when ``stmt`` is a bare
+    ``X.acquire()`` statement on a lock-named target, else None."""
+    if not isinstance(stmt, ast.Expr) or \
+            not isinstance(stmt.value, ast.Call):
+        return None
+    call = stmt.value
+    if not isinstance(call.func, ast.Attribute) or \
+            call.func.attr != "acquire":
+        return None
+    tail = _lock_tail(call.func.value)
+    if tail is None:
+        return None
+    try:
+        lock_src = ast.unparse(call.func.value)
+    except Exception:
+        lock_src = tail
+    return call, lock_src, tail
+
+
+def _releases(node, lock_src: str) -> bool:
+    """Whether ``node``'s subtree calls ``lock_src.release()``."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr == "release":
+            try:
+                if ast.unparse(sub.func.value) == lock_src:
+                    return True
+            except Exception:
+                continue
+    return False
+
+
+def _is_bare_release(stmt, lock_src: str) -> bool:
+    """``stmt`` IS ``lock_src.release()`` — the only spelling (besides
+    a try/finally release) that ends the held region UNCONDITIONALLY
+    at this point in the statement list."""
+    if not isinstance(stmt, ast.Expr) or \
+            not isinstance(stmt.value, ast.Call):
+        return False
+    call = stmt.value
+    if not isinstance(call.func, ast.Attribute) or \
+            call.func.attr != "release":
+        return False
+    try:
+        return ast.unparse(call.func.value) == lock_src
+    except Exception:
+        return False
+
+
+def _lint_acquire_regions(mod, fn, types, blocking) -> list[tuple]:
+    """The ``.acquire()/.release()`` spelling of GL004 (the ISSUE 12
+    satellite — until now only ``with`` blocks were analyzed, leaving
+    e.g. ``serving/artifacts.py:_EXPORT_LOCK`` invisible): a bare
+    ``X.acquire()`` statement opens a held region running to the
+    statement that releases X — the common shape being
+    ``acquire(); try: ...; finally: release()``, whose try body (and
+    handlers/else) executes entirely under the lock. Every finding of
+    a region is ANCHORED AT ITS ACQUIRE line (the acquire is the
+    decision being argued; one inline suppression there covers the
+    region, mirroring how a ``with`` line is one visible decision)."""
+    out = []
+    for stmts in _stmt_lists(fn.node):
+        for i, stmt in enumerate(stmts):
+            acq = _acquire_stmt(stmt, mod)
+            if acq is None:
+                continue
+            call, lock_src, tail = acq
+            kind = _lock_kind(types, fn, tail)
+            held: list = []
+            for later in stmts[i + 1:]:
+                if _is_bare_release(later, lock_src):
+                    # the region ends ONLY where the release executes
+                    # unconditionally at this nesting level
+                    break
+                if isinstance(later, ast.Try) and any(
+                        _releases(s, lock_src)
+                        for s in later.finalbody):
+                    # release lives in the finally: the try body,
+                    # handlers, and else all run under the lock
+                    # (finally stmts beside the release are left
+                    # alone — ordering them vs the release is more
+                    # precision than a linter should claim)
+                    held.extend(later.body)
+                    for handler in later.handlers:
+                        held.extend(handler.body)
+                    held.extend(later.orelse)
+                    break
+                if _releases(later, lock_src):
+                    # a CONDITIONAL or nested-def release (early-exit
+                    # branch, callback body): whether it runs here is
+                    # path-dependent — skip the ambiguous statement
+                    # itself but KEEP scanning, because the
+                    # fall-through path still holds the lock (ending
+                    # the region here was a silent false negative:
+                    # `if err: release(); return` followed by a sleep)
+                    continue
+                held.append(later)
+            if held:
+                out.extend(_lint_held_stmts(
+                    mod, fn, held, lock_src, kind, blocking,
+                    outer_with=None, anchor=call))
     return out
 
 
 def _lint_lock_body(mod, fn, with_node, lock_src, kind,
                     blocking) -> list[tuple]:
+    return _lint_held_stmts(mod, fn, with_node.body, lock_src, kind,
+                            blocking, outer_with=with_node, anchor=None)
+
+
+def _lint_held_stmts(mod, fn, stmts, lock_src, kind, blocking,
+                     outer_with, anchor) -> list[tuple]:
+    """Shared lock-held-region scan: ``stmts`` execute with
+    ``lock_src`` held (a with-body, or an acquire/release region).
+    ``anchor`` (the acquire call) re-anchors every finding to the
+    region head so one argued suppression covers the region; None
+    anchors at each offending node (the with spelling, where the
+    region head IS the surrounding with line)."""
     out = []
-    skip = _function_subtrees(with_node.body)
-    for stmt in with_node.body:
+    skip = _function_subtrees(stmts)
+
+    def flag(node, msg):
+        where = anchor if anchor is not None else node
+        if anchor is not None:
+            msg = f"{msg} (line {node.lineno}; " \
+                  "acquire()/release() region)"
+        out.append(("GL004", mod, where, msg))
+
+    for stmt in stmts:
         for node in ast.walk(stmt):
             if id(node) in skip:
                 continue
-            if isinstance(node, ast.With) and node is not with_node:
+            if isinstance(node, ast.With) and node is not outer_with:
                 for item in node.items:
                     try:
                         inner = ast.unparse(item.context_expr)
                     except Exception:
                         continue
                     if inner == lock_src and kind != "RLock":
-                        out.append((
-                            "GL004", mod, node,
-                            f"`{lock_src}` re-acquired inside its own "
-                            f"with-block in {fn.qualname} — a "
-                            "threading.Lock is not reentrant; this "
-                            "deadlocks"))
+                        flag(node,
+                             f"`{lock_src}` re-acquired inside its own "
+                             f"{'with-block' if outer_with is not None else 'acquire/release region'}"
+                             f" in {fn.qualname} — a threading.Lock is "
+                             "not reentrant; this deadlocks")
             if not isinstance(node, ast.Call):
                 continue
             label = _direct_blocking(node, mod)
             if label is not None:
-                out.append((
-                    "GL004", mod, node,
-                    f"`{lock_src}` held across {label} in "
-                    f"{fn.qualname} — blocking under a lock stalls "
-                    "every thread contending for it"))
+                flag(node,
+                     f"`{lock_src}` held across {label} in "
+                     f"{fn.qualname} — blocking under a lock stalls "
+                     "every thread contending for it")
                 continue
             callee = _resolve_local_call(node, fn, mod)
             if callee is None:
                 continue
             if callee.qualname in blocking:
-                out.append((
-                    "GL004", mod, node,
-                    f"`{lock_src}` held across call to "
-                    f"{callee.qualname} ({blocking[callee.qualname]}) "
-                    f"in {fn.qualname}"))
+                flag(node,
+                     f"`{lock_src}` held across call to "
+                     f"{callee.qualname} ({blocking[callee.qualname]}) "
+                     f"in {fn.qualname}")
             elif kind != "RLock" and lock_src.startswith("self.") and \
                     _acquires_lock(callee, lock_src):
-                out.append((
-                    "GL004", mod, node,
-                    f"`{lock_src}` re-acquired by callee "
-                    f"{callee.qualname} while held in {fn.qualname} — "
-                    "a threading.Lock is not reentrant; this "
-                    "deadlocks"))
+                flag(node,
+                     f"`{lock_src}` re-acquired by callee "
+                     f"{callee.qualname} while held in {fn.qualname} — "
+                     "a threading.Lock is not reentrant; this "
+                     "deadlocks")
     return out
 
 

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Convert repo-native observability artifacts to standard wire shapes.
+
+The trace plane exports TRACE.v1 JSONL (``utils/trace.py``) and the
+telemetry plane exports TELEMETRY.v1 snapshots (``utils.telemetry.
+Registry.dump`` — what ``exp.py --trace_dir`` and the serve bench
+write). Both are repo-native: compact, exact, and readable by the
+repo's own tools — but nothing else speaks them. This CLI converts
+either (or both at once) to:
+
+- **OTLP-shaped JSON** (default): one document carrying
+  ``resourceSpans`` (from every trace input) and ``resourceMetrics``
+  (from every telemetry input) in the OpenTelemetry protocol's JSON
+  encoding — hex trace/span ids (raw ids preserved as attributes),
+  unix-nano timestamps via the wall/monotonic anchor each input
+  carries, typed attribute values. POST the output at any
+  OTLP/HTTP-JSON collector endpoint and the repo's runs land in
+  whatever backend the fleet already operates.
+- **Prometheus text** (``--format prometheus``): the registry
+  snapshot's exposition-format rendering (trace inputs are refused in
+  this mode — spans have no exposition form).
+
+Inputs are self-describing: a file whose first JSON document carries a
+``TRACE.``-family ``schema`` header line is a trace; a ``TELEMETRY.``-
+family ``schema`` is a registry snapshot. Anything else is an error —
+a silently-skipped input would export a partial picture wearing a
+complete one's name.
+
+Examples::
+
+    # a traced+telemetered training run -> one OTLP document
+    python exp.py --trace_dir /tmp/tr --round 4 --n_repeats 1
+    python tools/obs_export.py /tmp/tr/exp1_satimage_trace.jsonl \\
+        /tmp/tr/exp1_satimage_telemetry.json -o run_otlp.json
+
+    # the serve bench's exported trace
+    SERVE_TRACE=/tmp/st python serve_bench.py
+    python tools/obs_export.py /tmp/st/serve_trace.jsonl -o serve.json
+
+    # registry snapshot -> Prometheus exposition text
+    python tools/obs_export.py --format prometheus \\
+        /tmp/tr/exp1_satimage_telemetry.json -o metrics.prom
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # runnable from any cwd, like graftlint
+    sys.path.insert(0, _REPO)
+
+from fedamw_tpu.utils.telemetry import (TELEMETRY_SCHEMA,  # noqa: E402
+                                        registry_to_otlp,
+                                        render_prometheus,
+                                        spans_to_otlp)
+from fedamw_tpu.utils.trace import read_jsonl  # noqa: E402
+
+#: Output schema tag of the combined OTLP document (the envelope is
+#: standard OTLP JSON; the tag names OUR bundling of spans + metrics in
+#: one file).
+OTLP_SCHEMA = "OBS_OTLP.v1"
+
+
+def classify_input(path: str) -> str:
+    """``"trace"`` or ``"telemetry"``, from the file's own schema
+    marker; raises ``ValueError`` for anything else."""
+    with open(path) as f:
+        head = f.readline().strip()
+    try:
+        doc = json.loads(head) if head else {}
+    except json.JSONDecodeError:
+        doc = {}
+    if not isinstance(doc, dict) or "schema" not in doc:
+        # a pretty-printed snapshot spans lines; fall back to the
+        # whole document before declaring the input unclassifiable
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            doc = {}
+    if not isinstance(doc, dict):
+        doc = {}
+    schema = str(doc.get("schema", ""))
+    if schema.startswith("TRACE."):
+        return "trace"
+    if schema.startswith("TELEMETRY."):
+        return "telemetry"
+    raise ValueError(
+        f"{path}: first JSON document carries schema {schema or None!r} "
+        f"— need a TRACE.-family JSONL header or a {TELEMETRY_SCHEMA} "
+        "snapshot")
+
+
+def load_trace(path: str) -> tuple[dict | None, list[dict]]:
+    """``(anchor, spans)`` from a TRACE.v1 JSONL (collector export or
+    a streaming part file). The anchor pair is header-borne
+    (``anchor_unix_s``/``anchor_mono_s``); streaming parts predate it
+    and yield None — the OTLP output then carries the monotonic
+    timeline, labeled as such."""
+    header, spans = read_jsonl(path)
+    anchor = None
+    if "anchor_unix_s" in header and "anchor_mono_s" in header:
+        anchor = {"unix_s": header["anchor_unix_s"],
+                  "mono_s": header["anchor_mono_s"]}
+    return anchor, spans
+
+
+def load_telemetry(path: str) -> dict:
+    with open(path) as f:
+        dump = json.load(f)
+    if not isinstance(dump, dict) or not str(
+            dump.get("schema", "")).startswith("TELEMETRY."):
+        raise ValueError(f"{path}: not a {TELEMETRY_SCHEMA} snapshot")
+    return dump
+
+
+def convert(paths, fmt: str = "otlp",
+            service_name: str = "fedamw_tpu") -> str:
+    """The CLI's core, importable for tests: classify every input,
+    convert, return the output document as a string."""
+    traces, dumps = [], []
+    for path in paths:
+        kind = classify_input(path)
+        if kind == "trace":
+            traces.append((path, *load_trace(path)))
+        else:
+            dumps.append((path, load_telemetry(path)))
+    if fmt == "prometheus":
+        if traces:
+            raise ValueError(
+                "prometheus format renders metric registries only; "
+                f"got trace input {traces[0][0]!r} (use the default "
+                "otlp format for spans)")
+        if not dumps:
+            raise ValueError("no telemetry snapshot inputs")
+        return "\n".join(render_prometheus(d) for _, d in dumps)
+    doc: dict = {"schema": OTLP_SCHEMA}
+    span_bundles = []
+    for path, anchor, spans in traces:
+        bundle = spans_to_otlp(spans, anchor=anchor,
+                               service_name=service_name)
+        span_bundles.extend(bundle["resourceSpans"])
+    metric_bundles = []
+    for path, dump in dumps:
+        bundle = registry_to_otlp(dump, service_name=service_name)
+        metric_bundles.extend(bundle["resourceMetrics"])
+    if span_bundles:
+        doc["resourceSpans"] = span_bundles
+    if metric_bundles:
+        doc["resourceMetrics"] = metric_bundles
+    return json.dumps(doc, indent=1)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="convert TRACE.v1 JSONL / TELEMETRY.v1 snapshots "
+                    "to OTLP JSON or Prometheus text")
+    ap.add_argument("inputs", nargs="+",
+                    help="trace JSONL and/or telemetry snapshot files "
+                         "(self-describing by schema header)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path (default: stdout)")
+    ap.add_argument("--format", choices=("otlp", "prometheus"),
+                    default="otlp")
+    ap.add_argument("--service-name", default="fedamw_tpu",
+                    help="OTLP resource service.name attribute")
+    args = ap.parse_args(argv)
+    try:
+        out = convert(args.inputs, fmt=args.format,
+                      service_name=args.service_name)
+    except (OSError, ValueError) as e:
+        print(f"obs_export: {e}", file=sys.stderr)
+        return 1
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out if out.endswith("\n") else out + "\n")
+        n = len(args.inputs)
+        print(f"obs_export: {n} input(s) -> {args.out} "
+              f"({args.format})", file=sys.stderr)
+    else:
+        print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
