@@ -29,11 +29,29 @@ drift. The two pieces:
   (entries before/after) so a warm capture can never masquerade as a
   cold one. Must be entered BEFORE the first jit dispatch — jax
   latches its cache-enabled decision at first use.
+- :func:`open_loop_offsets` — the seeded open-loop arrival schedule
+  (ISSUE 13): exponential inter-arrivals at a target rate, as
+  cumulative offsets a load generator sleeps against. Open-loop is
+  what makes queue percentiles measure SERVICE UNDER LOAD — the old
+  enqueue-everything-then-drain streams measured backlog drain
+  (queue_depth_peak == requests), which is a different quantity.
+  Seeded so paired before/after legs replay the identical schedule.
 """
 
 import contextlib
 import os
 import sys
+
+
+def open_loop_offsets(rng, n: int, req_per_s: float):
+    """``n`` cumulative arrival offsets (seconds) at mean rate
+    ``req_per_s``, exponential inter-arrivals drawn from ``rng`` (a
+    ``numpy.random.RandomState``) — the seeded Poisson load shape."""
+    if req_per_s <= 0:
+        raise ValueError(f"req_per_s must be positive, got {req_per_s}")
+    import numpy as np
+
+    return np.cumsum(rng.exponential(1.0 / float(req_per_s), int(n)))
 
 
 def reapply_jax_platforms() -> str:

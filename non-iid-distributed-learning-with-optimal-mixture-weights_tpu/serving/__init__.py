@@ -3,8 +3,12 @@
 The train side of the repo ends at ``utils/checkpoint.py``; this package
 is the serve side: ``engine`` (checkpoint -> one fused jitted predictor,
 bucket-ladder compiled, mesh-replicable, with a versioned weight store
-for zero-recompile hot swaps), ``batcher`` (dynamic micro-batching),
-``service`` (stdlib thread+queue request loop with deadlines, overload
+for zero-recompile hot swaps and atomic rung install/retire),
+``batcher`` (continuous-batching admission plus the legacy
+fixed-micro-batch drain), ``ladder`` (rung sets learned from the
+telemetry registry's observed request-size series under explicit
+pad-waste and recompile budgets), ``service`` (stdlib thread+queue
+request loop with deadlines, overload
 shedding, and rollout-aware traffic splitting), ``metrics`` (latency
 percentiles / throughput / shed counters / model-version + staleness
 dimensions), ``registry`` (versioned model store closing the
@@ -23,9 +27,12 @@ in the ``bench.py`` schema family with the same strict-backend guard.
 
 from .artifacts import (ArtifactIncompatible, ArtifactManifest,
                         export_ladder, load_ladder, prune_artifacts)
-from .batcher import MicroBatcher, coalesce, drain, partition, split_results
+from .batcher import (MicroBatcher, admit, coalesce, drain, partition,
+                      rung_cut, split_results)
 from .chaos import ChaosFault, ChaosPlan, ChaosSpec, resolve_chaos_plan
 from .engine import DEFAULT_BUCKETS, ServingEngine, bucket_for, infer_model
+from .ladder import (LadderLearner, LadderProposal, apply_proposal,
+                     ladder_waste, learn_ladder)
 from .metrics import LatencyHistogram, ServeMetrics
 from .registry import CheckpointWatcher, ModelRegistry, ModelVersion
 from .replica import (FailoverRouter, NoReplicasAvailable, Replica,
@@ -44,6 +51,8 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "DeadlineExceeded",
     "FailoverRouter",
+    "LadderLearner",
+    "LadderProposal",
     "LatencyHistogram",
     "MicroBatcher",
     "ModelRegistry",
@@ -59,16 +68,21 @@ __all__ = [
     "ServiceStopped",
     "ServingEngine",
     "ServingService",
+    "admit",
+    "apply_proposal",
     "assigned_to_candidate",
     "bucket_for",
     "coalesce",
     "drain",
     "export_ladder",
     "infer_model",
+    "ladder_waste",
+    "learn_ladder",
     "load_ladder",
     "partition",
     "prune_artifacts",
     "resolve_chaos_plan",
+    "rung_cut",
     "split_key",
     "split_results",
 ]

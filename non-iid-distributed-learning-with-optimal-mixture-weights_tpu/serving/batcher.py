@@ -3,15 +3,25 @@
 Requests arrive as independent ``(k_i, d)`` (or single-row) arrays; the
 engine wants one padded bucket per XLA dispatch. The split is
 deliberate: :func:`coalesce`/:func:`split_results` are pure functions
-over request lists (trivially testable), :func:`drain` is the queue-side
-accumulation policy (grab what's already waiting, linger at most
-``max_wait`` for stragglers, never exceed the engine's largest bucket),
-and ``service.py`` owns the thread that glues them to a live queue.
+over request lists (trivially testable), :func:`admit`/:func:`drain`
+are the queue-side accumulation policies, and ``service.py`` owns the
+thread that glues them to a live queue.
 
-The wait bound trades tail latency for batch occupancy exactly like any
-production batcher: under load the queue is never empty so ``drain``
-returns instantly with a full bucket; at low rates a request waits at
-most ``max_wait`` before flying solo in the smallest rung.
+Two admission policies, one holdover contract:
+
+- :func:`admit` — **continuous batching** (the default since ISSUE 13):
+  take everything already queued, NEVER wait for stragglers. Occupancy
+  comes from pipelining, not lingering: while the previous dispatch
+  occupied the engine, new arrivals accumulated in the queue, and the
+  moment the rung frees the worker admits all of them into the next
+  dispatch. Under load batches fill themselves; at low rates a request
+  flies solo immediately instead of idling ``max_wait`` first.
+- :func:`drain` — the legacy fixed-micro-batch policy (grab what's
+  waiting, linger up to ``max_wait`` for more, aim at the LARGEST
+  bucket). Kept as the explicitly-selectable baseline the serve
+  bench's ``continuous_batching`` leg measures against: the wait bound
+  trades tail latency for batch occupancy, and that trade is exactly
+  what continuous admission deletes.
 """
 
 from __future__ import annotations
@@ -88,6 +98,73 @@ def drain(q: "queue.Queue", first, max_rows: int,
         batch.append(nxt)
         rows += n
     return batch, None
+
+
+def admit(q: "queue.Queue", seed, max_rows: int) -> tuple:
+    """Continuous-batching admission: accumulate a batch from ``seed``
+    (one request, or the worker's carried list of deferred requests)
+    plus everything ALREADY queued, without ever waiting.
+
+    The pipelining twin of :func:`drain`: the worker calls this the
+    moment the previous dispatch returns, so the "wait" for batch
+    occupancy is the previous rung's dispatch time — requests that
+    arrived during it are admitted now, and an empty queue dispatches
+    the seed alone immediately. The holdover contract is identical to
+    :func:`drain`: the request that would exceed ``max_rows`` is never
+    split and is handed back to seed the NEXT batch, bounding its extra
+    delay to one dispatch.
+    """
+    batch = list(seed) if isinstance(seed, list) else [seed]
+    rows = sum(request_rows(r.x) if hasattr(r, "x") else
+               request_rows(r) for r in batch)
+    while rows < max_rows:
+        try:
+            nxt = q.get_nowait()
+        except queue.Empty:
+            break
+        n = request_rows(nxt.x) if hasattr(nxt, "x") else \
+            request_rows(nxt)
+        if rows + n > max_rows:
+            return batch, nxt
+        batch.append(nxt)
+        rows += n
+    return batch, None
+
+
+def rung_cut(rows_list, rungs) -> int:
+    """Rung-aware batch cut: how many leading requests of an admitted
+    batch to dispatch NOW so the dispatch lands near a ladder rung
+    instead of padding deep into the next one.
+
+    An eagerly-admitted batch totalling just past a rung (e.g. 271
+    rows against a ``256/512`` ladder) would pad nearly double its
+    rows; cutting it back to the longest prefix fitting the rung BELOW
+    the total serves those rows almost pad-free, and the deferred tail
+    seeds the immediately-following dispatch — one batch of extra
+    delay, the holdover bound. The cut only fires when the lower rung
+    covers at least HALF the total (``2 * lower >= total``): cutting
+    deeper would trade a little padding for a mostly-empty dispatch,
+    which costs more throughput than the padding did. Returns an index
+    in ``[1, len(rows_list)]`` (never 0 — the head request always
+    dispatches, requests are never split).
+    """
+    total = sum(rows_list)
+    lower = None
+    for b in rungs:
+        if b > total:
+            break
+        if b == total:
+            return len(rows_list)  # exact fill: nothing to trim
+        lower = b
+    if lower is None or 2 * lower < total:
+        return len(rows_list)
+    rows = cut = 0
+    for n in rows_list:
+        if rows + n > lower:
+            break
+        rows += n
+        cut += 1
+    return cut if cut >= 1 else len(rows_list)
 
 
 def partition(requests, predicate) -> tuple[list, list]:

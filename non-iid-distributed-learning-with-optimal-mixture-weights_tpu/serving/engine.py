@@ -144,6 +144,12 @@ class ServingEngine:
         if not ladder or ladder[0] <= 0:
             raise ValueError(f"bad bucket ladder {buckets!r}")
         self.buckets = tuple(ladder)
+        # ladder lifecycle lock (install_rung/retire_rung): the rung
+        # set is published as ONE tuple swap under it, so a dispatch
+        # reads a consistent ladder without taking any lock — the same
+        # atomic-flip discipline as the versioned weight store
+        self._ladder_lock = threading.Lock()
+        self._n_dev = n_dev
 
         if mesh is not None:
             from ..parallel.mesh import batch_spec
@@ -556,11 +562,16 @@ class ServingEngine:
         return engine
 
     def _run(self, X: np.ndarray, weights: tuple,
-             timings: dict) -> np.ndarray:
+             timings: dict, ladder=None) -> np.ndarray:
         params, rff, v = weights
         t0 = time.perf_counter()
         n, d = X.shape
-        b = bucket_for(n, self.buckets)
+        # `ladder` is the caller's one-read snapshot of the rung tuple
+        # (predict latches it): re-reading self.buckets here could see
+        # a concurrent retire_rung and raise on a batch the latched
+        # ladder covers — the in-flight dispatches retire_rung
+        # promises to keep serving
+        b = bucket_for(n, self.buckets if ladder is None else ladder)
         if n < b:
             X = np.concatenate(
                 [X, np.zeros((b - n, d), X.dtype)], axis=0)
@@ -631,12 +642,17 @@ class ServingEngine:
         if X.ndim != 2 or X.shape[1] != self.input_dim:
             raise ValueError(
                 f"expected (n, {self.input_dim}) rows, got {X.shape}")
-        top = self.buckets[-1]
+        # ONE ladder read for the whole call: chunking decision and
+        # rung choice must agree even while install_rung/retire_rung
+        # swap the tuple concurrently (the compiled program for any
+        # latched rung stays cached, so the old ladder still serves)
+        ladder = self.buckets
+        top = ladder[-1]
         if X.shape[0] <= top:
-            out = self._run(X, weights, timings)
+            out = self._run(X, weights, timings, ladder)
         else:
             out = np.concatenate(
-                [self._run(X[lo:lo + top], weights, timings)
+                [self._run(X[lo:lo + top], weights, timings, ladder)
                  for lo in range(0, X.shape[0], top)], axis=0)
         if record_timings:
             # one reference assignment AFTER the call completed: the
@@ -683,6 +699,95 @@ class ServingEngine:
         attr = attribute_device_time(dispatch, reps=reps)
         attr["bucket"] = b
         return attr
+
+    # -- ladder lifecycle (the ISSUE 13 learned-ladder plane) ---------
+    def _warm_shape(self, b: int) -> None:
+        """Compile-and-run the predictor at rung ``b`` on zeros, on the
+        CALLER's thread — the deliberate off-hot-path compile that
+        makes :meth:`install_rung` publish only WARM rungs. Blocks
+        until the program has actually executed (a lazily-compiled
+        publish would move the compile onto the first real dispatch,
+        exactly what the zero-recompile-after-freeze pin forbids)."""
+        weights = self._resolve(None)
+        X = np.zeros((b, self.input_dim), np.float32)
+        x = (jnp.asarray(X) if self._in_spec is None
+             else jax.device_put(X, self._in_spec))
+        self._shapes_seen.add(X.shape)  # compile-count fallback basis
+        np.asarray(self._predict(x, weights[0], weights[1]))
+
+    def install_rung(self, bucket: int, aot=None) -> int:
+        """Atomically grow the ladder by one rung, pre-warmed BEFORE it
+        is published — the learned-ladder re-bucketing primitive
+        (``serving/ladder.py``), built the same way weight swaps work:
+        all the expensive work happens off the serving hot path, then
+        one tuple swap under the ladder lock makes the rung visible.
+        Call it from any thread EXCEPT the serving worker (the compile
+        is seconds-scale; the worker keeps dispatching the existing
+        rungs through it untouched). Returns the installed rung size
+        (rounded up to a mesh-device multiple like the constructor).
+
+        On an artifact-loaded engine nothing may compile at all: pass
+        ``aot=`` — a rung executable deserialized through the PR 9
+        artifact plane (``serving.artifacts.load_ladder`` of a
+        re-exported ladder) — or this raises rather than silently
+        routing the new rung through the (empty) jit cache."""
+        b = -(-int(bucket) // self._n_dev) * self._n_dev
+        if b <= 0:
+            raise ValueError(f"rung must be positive, got {bucket}")
+        if b in self.buckets:
+            raise ValueError(f"{b} is already a ladder rung "
+                             f"{self.buckets}")
+        if self._aot is not None:
+            if aot is None:
+                raise ValueError(
+                    "artifact-loaded engine: install_rung needs aot= "
+                    "(a rung executable from serving.artifacts."
+                    "load_ladder of a re-exported ladder) — compiling "
+                    "here would defeat the cold-start plane's "
+                    "zero-compile contract")
+        else:
+            if aot is not None:
+                # refuse rather than silently discard: a jit engine
+                # dispatches through its own cache, so the supplied
+                # executable would never run and the caller would pay
+                # the compile it explicitly exported to avoid
+                raise ValueError(
+                    "aot= is for artifact-loaded engines "
+                    "(from_artifact); this engine compiles its rungs "
+                    "— drop aot=, or load the engine from the "
+                    "artifact plane")
+            self._warm_shape(b)  # the pre-warm: compile HERE, not on
+            # the serving thread's next dispatch
+        with self._ladder_lock:
+            if b in self.buckets:
+                raise ValueError(
+                    f"{b} is already a ladder rung {self.buckets} "
+                    "(concurrent install)")
+            if self._aot is not None:
+                self._aot[b] = aot
+            self.buckets = tuple(sorted(set(self.buckets) | {b}))
+        return b
+
+    def retire_rung(self, bucket: int) -> None:
+        """Atomically drop a rung from the ladder (requests that would
+        have used it pad up to the next rung, or chunk at the new top).
+        The compiled program stays cached — an in-flight dispatch that
+        read the old ladder still serves through it with zero
+        recompiles, and ``compile_count`` never moves. Refuses to
+        retire the last rung (the engine must always have a ladder)."""
+        b = int(bucket)
+        with self._ladder_lock:
+            if b not in self.buckets:
+                raise KeyError(
+                    f"{b} is not a ladder rung {self.buckets}")
+            if len(self.buckets) == 1:
+                raise ValueError(
+                    f"{b} is the last rung; the ladder must keep at "
+                    "least one")
+            # _aot deliberately keeps the retired executable: an
+            # in-flight AOT dispatch that latched the old ladder must
+            # find its program, never fall through to a compile
+            self.buckets = tuple(x for x in self.buckets if x != b)
 
     def warmup(self) -> int:
         """Compile every bucket (zeros input); returns the compile

@@ -41,6 +41,10 @@ import time
 
 from ..utils.telemetry import Registry, SloEvaluator
 
+#: Bucket bounds (in ROWS) for the request/batch size histograms —
+#: powers of two spanning the single-row to max-default-rung range.
+ROWS_BOUNDS = tuple(float(2 ** k) for k in range(13))
+
 
 class LatencyHistogram:
     """Exact-percentile latency recorder with reservoir degradation."""
@@ -54,13 +58,26 @@ class LatencyHistogram:
 
     def record(self, seconds: float) -> None:
         with self._lock:
-            self._seen += 1
-            if len(self._samples) < self.max_samples:
-                self._samples.append(seconds)
-            else:
-                j = self._rng.randrange(self._seen)
-                if j < self.max_samples:
-                    self._samples[j] = seconds
+            self._record_locked(seconds)
+
+    def record_many(self, seconds) -> None:
+        """Record a batch of samples under ONE lock round-trip — the
+        serving worker records every request of a micro-batch at once,
+        and under continuous batching (many small batches) per-sample
+        locking was a measurable slice of the telemetry plane's cost
+        (the serve bench's <=1.05x bound)."""
+        with self._lock:
+            for s in seconds:
+                self._record_locked(s)
+
+    def _record_locked(self, seconds: float) -> None:
+        self._seen += 1
+        if len(self._samples) < self.max_samples:
+            self._samples.append(seconds)
+        else:
+            j = self._rng.randrange(self._seen)
+            if j < self.max_samples:
+                self._samples[j] = seconds
 
     @property
     def count(self) -> int:
@@ -168,6 +185,18 @@ class ServeMetrics:
         self._c_staleness_err = reg.counter(
             "serve_staleness_errors_total",
             "failed live staleness lookups")
+        self._c_probe_dropped = reg.counter(
+            "serve_shadow_probes_dropped_total",
+            "shadow probes dropped at the off-thread probe queue")
+        # request/batch size evidence (the ISSUE 13 signal): raw row
+        # counts as histogram SERIES — what the ladder learner
+        # (serving/ladder.py) reads to re-bucket from observed traffic
+        self._h_req_rows = reg.histogram(
+            "serve_request_rows", "rows per served request",
+            bounds=ROWS_BOUNDS)
+        self._h_batch_rows = reg.histogram(
+            "serve_batch_rows", "rows per dispatched micro-batch",
+            bounds=ROWS_BOUNDS)
         self._g_queue_depth = reg.gauge(
             "serve_queue_depth", "observed queue depth at submit")
         self._g_staleness = reg.gauge(
@@ -256,6 +285,10 @@ class ServeMetrics:
     def staleness_errors(self) -> int:
         return int(self._c_staleness_err.value)
 
+    @property
+    def shadow_probes_dropped(self) -> int:
+        return int(self._c_probe_dropped.value)
+
     # -- recording ----------------------------------------------------
     def _class_hist(self, slo_class: str):
         hist = self._lat_class.get(slo_class)
@@ -315,6 +348,14 @@ class ServeMetrics:
         instead of reading as a permanently-current service."""
         self._c_staleness_err.inc()
 
+    def record_probe_dropped(self, n_requests: int = 1) -> None:
+        """Shadow probes shed at the off-thread probe queue (the queue
+        bounds probe backlog so a slow candidate can never leak memory
+        on the probe thread) — counted, never silent: the rollout
+        controller sees fewer observations, and an operator can tell
+        "candidate under-observed" from "candidate healthy"."""
+        self._c_probe_dropped.inc(int(n_requests))
+
     def record_retry(self) -> None:
         """One transient engine-dispatch failure absorbed by the
         service's bounded-backoff retry (``service._serve_batch``).
@@ -339,7 +380,8 @@ class ServeMetrics:
                      now: float | None = None,
                      stage_seconds: dict | None = None,
                      request_retries: list[int] | None = None,
-                     version=None, slo_classes=None) -> None:
+                     version=None, slo_classes=None,
+                     rows_per_request: list[int] | None = None) -> None:
         """``stage_seconds``: ``{"queue": [per-request s, ...],
         "pad": s, "device": s}`` — scalar stages are batch-shared and
         recorded once per request (see ``stage_latency``).
@@ -350,11 +392,17 @@ class ServeMetrics:
         ``slo_classes``: per-request SLO class names aligned with
         ``latencies`` (default: every request in the "default" class)
         — the label on the registry latency family the SLO evaluator
-        reads."""
+        reads. ``rows_per_request``: per-request row counts — the
+        request-size evidence the ladder learner consumes
+        (``serve_request_rows``); the batch total always lands on
+        ``serve_batch_rows``."""
         now = time.perf_counter() if now is None else now
         self._c_batches.inc()
         self._c_requests.inc(int(n_requests))
         self._c_rows.inc(int(n_rows))
+        self._h_batch_rows.observe(int(n_rows))
+        if rows_per_request:
+            self._h_req_rows.observe_many(rows_per_request)
         with self._lock:
             if version is not None:
                 self.requests_by_version[version] = (
@@ -370,19 +418,34 @@ class ServeMetrics:
                 n_retried = 0
         if n_retried:
             self._c_requests_retried.inc(n_retried)
-        for i, s in enumerate(latencies):
-            self.latency.record(s)
-            cls = (slo_classes[i] if slo_classes else None) or "default"
-            self._class_hist(cls).observe(s)
+        # bulk paths throughout: one lock round-trip per instrument
+        # per BATCH, not per request — under continuous batching the
+        # batch count multiplies, and per-sample locking here was a
+        # measurable slice of the telemetry plane's <=1.05x budget
+        self.latency.record_many(latencies)
+        if slo_classes and len(slo_classes) != len(latencies):
+            # the old per-index loop raised IndexError on a short
+            # list; the bulk zip below would silently truncate — and
+            # a per-class family quietly missing samples skews the
+            # SLO signal with no error anywhere
+            raise ValueError(
+                f"slo_classes ({len(slo_classes)}) must align with "
+                f"latencies ({len(latencies)})")
+        if slo_classes:
+            by_cls: dict = {}
+            for s, cls in zip(latencies, slo_classes):
+                by_cls.setdefault(cls or "default", []).append(s)
+            for cls, vals in by_cls.items():
+                self._class_hist(cls).observe_many(vals)
+        else:
+            self._class_hist("default").observe_many(latencies)
         if stage_seconds:
             for stage, val in stage_seconds.items():
                 hist = self.stage_latency[stage]
                 if isinstance(val, (list, tuple)):
-                    for v in val:
-                        hist.record(v)
+                    hist.record_many(val)
                 else:
-                    for _ in range(n_requests):
-                        hist.record(val)
+                    hist.record_many([val] * int(n_requests))
 
     # -- SLO / export surfaces ----------------------------------------
     def slo(self, classes=None, windows_s=(60.0, 300.0)) -> dict:
@@ -440,6 +503,7 @@ class ServeMetrics:
             "staleness_rounds": staleness_rounds,
             "weight_swaps": self.weight_swaps,
             "shadow_requests": self.shadow_requests,
+            "shadow_probes_dropped": self.shadow_probes_dropped,
             "candidate_errors": self.candidate_errors,
             "rollbacks": self.rollbacks,
             "requests_by_version": {

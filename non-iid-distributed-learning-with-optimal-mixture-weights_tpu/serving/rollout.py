@@ -14,11 +14,11 @@ irreversible-free path:
               per-request-id hash (``assigned_to_candidate``):
               *shadow* mode dispatches the candidate on the assigned
               requests but answers every caller from the live version
-              (dark launch — invisible in ANSWERS, not in capacity:
-              the probe rides the serving worker thread, so a
-              fraction-f shadow costs ~f extra dispatches and shows
-              up in tail latency under saturation; off-thread probes
-              are a ROADMAP follow-on); *ab* mode answers the
+              (dark launch — since ISSUE 13 the probe runs on the
+              service's dedicated probe thread, so candidate warm
+              dispatch no longer serializes behind live traffic on
+              the worker; probes past the bounded probe queue are
+              shed and COUNTED, never blocking); *ab* mode answers the
               assigned slice from the candidate, falling back to the
               live version on any candidate dispatch failure so a bad
               canary degrades to the old model, never to an error.

@@ -3,11 +3,24 @@
 Deliberately stdlib-only (``threading``/``queue``/``concurrent.futures``
 — no server framework; the container adds no runtime deps and a real
 deployment would front this with whatever RPC layer it already has).
-The loop is the standard dynamic-batching serving shape:
+The loop is the continuous-batching serving shape (ISSUE 13):
 
-  submit() -> bounded queue -> worker drains a micro-batch
-  (batcher.drain) -> expired requests shed -> one engine dispatch ->
-  per-request futures resolved.
+  submit() -> bounded queue -> worker admits everything queued the
+  moment the previous dispatch returns (batcher.admit — no linger) ->
+  expired requests shed -> one engine dispatch -> per-request futures
+  resolved.
+
+Queue admission pipelines with rung dispatch: while one batch occupies
+the engine, arrivals accumulate; the instant the rung frees they are
+admitted into the next dispatch. Under load batches fill themselves
+(the previous dispatch time IS the batching window); at low rates a
+request dispatches solo immediately. ``mode="drain"`` selects the
+legacy fixed-micro-batch policy (linger up to ``max_wait_ms`` filling
+toward the largest rung) — kept as the measured baseline of the serve
+bench's ``continuous_batching`` leg. The worker re-reads the
+engine's ladder per batch, so atomically-installed learned rungs
+(``ServingEngine.install_rung`` / ``serving/ladder.py``) take effect
+mid-stream with zero hot-path compiles.
 
 Overload policy is shed-at-the-door: when the queue holds ``max_queue``
 requests, ``submit`` fails IMMEDIATELY with :class:`Overloaded` instead
@@ -37,8 +50,8 @@ from concurrent.futures import Future, InvalidStateError
 import numpy as np
 
 from ..utils.trace import NULL_TRACER
-from .batcher import (coalesce, drain, partition, request_rows,
-                      split_results)
+from .batcher import (admit, coalesce, drain, partition, request_rows,
+                      rung_cut, split_results)
 from .metrics import ServeMetrics
 from .rollout import assigned_to_candidate
 
@@ -115,11 +128,30 @@ class ServingService:
     ``concurrent.futures.Future`` resolving to the request's logits.
     """
 
+    #: Batch-formation policies: continuous admission (admit whatever
+    #: is queued the moment the previous dispatch returns — the
+    #: default) vs the legacy fixed-micro-batch drain (linger up to
+    #: ``max_wait_ms`` filling toward the top rung — the measured
+    #: baseline of the serve bench's continuous_batching leg).
+    MODES = ("continuous", "drain")
+
     def __init__(self, engine, max_queue: int = 1024,
                  max_wait_ms: float = 2.0, metrics: ServeMetrics | None = None,
                  retries: int = 2, retry_backoff_ms: float = 5.0,
-                 tracer=None, router=None):
-        """``retries``/``retry_backoff_ms``: bounded exponential-backoff
+                 tracer=None, router=None, mode: str = "continuous",
+                 rung_aware: bool = False):
+        """``mode``: batch-formation policy (:data:`MODES`). In
+        ``"continuous"`` (default) ``max_wait_ms`` is unused — the
+        batching window is the previous dispatch itself; ``"drain"``
+        keeps the PR 1 fixed-micro-batch semantics. ``rung_aware``
+        (continuous mode only): cut each admitted batch back to a
+        ladder rung boundary (``batcher.rung_cut``) when padding past
+        it would out-cost deferring the tail one dispatch — worth
+        turning on where pad rows cost real device time (TPU); on CPU
+        hosts per-dispatch overhead dominates and the serve bench
+        measured the cut net-negative, hence default off.
+
+        ``retries``/``retry_backoff_ms``: bounded exponential-backoff
         retry of TRANSIENT engine-dispatch failures (``_is_transient``;
         a flapping remote-accelerator tunnel) — at most ``retries``
         re-dispatches per batch, backoff doubling from
@@ -146,8 +178,13 @@ class ServingService:
         mode, answered-from-candidate (with live fallback on failure)
         in ab mode — reporting outcomes back via ``router.observe``.
         None serves everything from the engine's live version."""
+        if mode not in self.MODES:
+            raise ValueError(f"mode must be one of {self.MODES}, "
+                             f"got {mode!r}")
         self.engine = engine
         self.router = router
+        self.mode = mode
+        self.rung_aware = bool(rung_aware)
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.max_queue = int(max_queue)
         self.max_wait = max_wait_ms / 1e3
@@ -181,6 +218,13 @@ class ServingService:
         self._depth_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # off-thread shadow probing (ISSUE 13 satellite, the PR 7
+        # carried follow-on): shadow dispatches ride a dedicated
+        # daemon thread instead of serializing behind live traffic on
+        # the worker. Bounded queue so a slow candidate sheds probes
+        # (counted) instead of growing probe backlog without bound.
+        self._probe_q: queue.Queue = queue.Queue(maxsize=256)
+        self._probe_thread: threading.Thread | None = None
 
     # -- tracing ------------------------------------------------------
     def _staleness(self, version) -> int:
@@ -312,6 +356,14 @@ class ServingService:
             self._stop.set()
         self._thread.join()
         self._thread = None
+        if self._probe_thread is not None:
+            # the worker is joined, so no probe can be enqueued after
+            # this sentinel: every accepted probe is processed before
+            # stop returns (a caller's post-stop snapshot sees the
+            # full shadow_requests count, same contract as in-line)
+            self._probe_q.put(None)
+            self._probe_thread.join()
+            self._probe_thread = None
         self._sweep_leftovers(drain_queue)
 
     def _sweep_leftovers(self, drain_queue: bool) -> None:
@@ -366,7 +418,8 @@ class ServingService:
                 stage_seconds={"queue": [queue_s], "pad": pad_s,
                                "device": device_s},
                 request_retries=[req.retries], version=ver,
-                slo_classes=[req.slo])
+                slo_classes=[req.slo],
+                rows_per_request=[request_rows(req.x)])
             self._trace_request(req, "ok", done, queue_s=queue_s,
                                 pad_s=pad_s, device_s=device_s,
                                 version=ver, extra=rext)
@@ -447,23 +500,65 @@ class ServingService:
 
     # -- worker side --------------------------------------------------
     def _worker(self) -> None:
-        max_rows = self.engine.buckets[-1]
-        held: _Request | None = None  # drain's over-budget holdover —
-        # it seeds the NEXT batch, so a large request's extra delay is
-        # bounded to one batch instead of starving behind fresh arrivals
+        carry: list = []  # requests dequeued but not yet dispatched:
+        # the over-budget holdover plus (continuous mode) the
+        # rung-cut's deferred tail. Carried requests seed the NEXT
+        # batch ahead of fresh arrivals, so a deferred request's extra
+        # delay is bounded to one dispatch — it can never starve
+        # behind a sustained stream (they advance strictly frontward
+        # each cycle, and every dispatch serves at least one of them)
         while True:
-            if held is not None:
-                first, held = held, None
-            else:
+            if not carry:
                 try:
-                    first = self._q.get(timeout=0.02)
+                    carry = [self._q.get(timeout=0.02)]
                 except queue.Empty:
                     if self._stop.is_set():
                         return
                     continue
-            batch, held = drain(self._q, first, max_rows,
-                                max_wait=0.0 if self._stop.is_set()
-                                else self.max_wait)
+            # re-read the ladder top EVERY batch: install_rung/
+            # retire_rung swap the rung tuple atomically at runtime
+            # (the learned-ladder plane), and a latched max would cap
+            # admission at a stale ladder forever
+            ladder = self.engine.buckets
+            max_rows = ladder[-1]
+            if self.mode == "continuous" or self._stop.is_set():
+                # continuous batching: admit what is queued NOW — the
+                # previous dispatch was the batching window, nothing
+                # lingers (also the shutdown drain: stop must not
+                # wait). With rung_aware set, the batch is then cut
+                # back to a rung boundary when padding past it would
+                # out-cost the deferral (a DEVICE-bound policy: on
+                # CPU hosts per-dispatch overhead dominates pad rows
+                # and the serve bench measured the cut net-negative,
+                # so it is opt-in, for backends where pad rows cost
+                # real device time)
+                batch, held = admit(self._q, carry, max_rows)
+                # hard-cap the batch at the rung budget: a carried
+                # seed can EXCEED it when a rung-cut tail stacks with
+                # a holdover, and dispatching past the top rung would
+                # make the engine chunk the coalesced batch — splitting
+                # a request across dispatches, the exact thing the
+                # holdover contract forbids. The head request always
+                # dispatches (oversized singles are the engine's
+                # documented chunking case).
+                rows_list = [request_rows(r.x) for r in batch]
+                cap, rows = 1, rows_list[0]
+                while cap < len(batch) and \
+                        rows + rows_list[cap] <= max_rows:
+                    rows += rows_list[cap]
+                    cap += 1
+                carry = batch[cap:]
+                batch = batch[:cap]
+                if self.rung_aware:
+                    cut = rung_cut(rows_list[:cap], ladder)
+                    carry = batch[cut:] + carry
+                    batch = batch[:cut]
+            else:
+                batch, held = drain(self._q, carry[0], max_rows,
+                                    max_wait=self.max_wait)
+                carry = []
+            if held is not None:
+                carry.append(held)
             with self._depth_lock:
                 # these requests left the queue for good (the holdover
                 # stays accounted until its own batch serves it)
@@ -518,7 +613,27 @@ class ServingService:
             probe = [(r, o) for r, o in pairs or []
                      if assigned_to_candidate(r.id, fraction)]
             if probe:
-                self._shadow_probe(probe, cand_ver, router, bid)
+                if self._predict_untimed:
+                    # off-thread warm dispatch (the PR 7 follow-on):
+                    # the probe's callers were ALREADY answered from
+                    # the live outputs, so nothing user-visible waits
+                    # on it — hand it to the probe thread instead of
+                    # serializing candidate dispatch behind the next
+                    # live batch. Requires the out-of-band dispatch
+                    # mode (record_timings=False): without it the
+                    # probe's pop-and-discard would race this thread's
+                    # own timing slot, so such engines keep the
+                    # in-line probe.
+                    self._ensure_probe_thread()
+                    try:
+                        self._probe_q.put_nowait(
+                            (probe, cand_ver, router, bid))
+                    except queue.Full:
+                        # shed, never block the worker: counted so an
+                        # under-observed candidate is visible
+                        self.metrics.record_probe_dropped(len(probe))
+                else:
+                    self._shadow_probe(probe, cand_ver, router, bid)
             return
         assigned, rest = partition(
             live, lambda r: assigned_to_candidate(r.id, fraction))
@@ -526,6 +641,35 @@ class ServingService:
             self._serve_group(rest, None, bid)
         if assigned:
             self._serve_group(assigned, cand_ver, bid, router=router)
+
+    def _ensure_probe_thread(self) -> None:
+        """Start the shadow-probe thread on first use. Called only
+        from the worker thread, so creation cannot race itself."""
+        if self._probe_thread is None:
+            self._probe_thread = threading.Thread(
+                target=self._probe_worker, name="serve-shadow-probe",
+                daemon=True)
+            self._probe_thread.start()
+
+    def _probe_worker(self) -> None:
+        """Drain the probe queue until the shutdown sentinel (None).
+        Probes dispatch out-of-band (``record_timings=False``), so
+        nothing here can bill timing or version into the serving
+        worker's slot — the property that made this safe to move off
+        the worker thread."""
+        while True:
+            item = self._probe_q.get()
+            if item is None:
+                return
+            probe, cand_ver, router, bid = item
+            try:
+                self._shadow_probe(probe, cand_ver, router, bid)
+            except Exception:
+                # a probe failure must never kill the probe thread
+                # (every later candidate would silently go
+                # unobserved); count it into the candidate budget —
+                # the same signal a failed in-line probe feeds
+                self.metrics.record_candidate_error(len(probe))
 
     def _shadow_probe(self, probe, cand_ver, router, bid) -> None:
         """Dark-launch dispatch: the assigned ``(request, live_out)``
@@ -712,16 +856,18 @@ class ServingService:
             router.observe(use_version, served=len(live))
         # metrics BEFORE resolving futures: a caller that waits on
         # its future and then snapshots must see this batch counted
+        rows_each = [request_rows(r.x) for r in live]
         self.metrics.record_batch(
             n_requests=len(live),
-            n_rows=sum(request_rows(r.x) for r in live),
+            n_rows=sum(rows_each),
             latencies=[done - r.t_submit for r in live],
             now=done,
             stage_seconds={"queue": queue_waits, "pad": pad_s,
                            "device": device_s},
             request_retries=[r.retries for r in live],
             version=served_ver,
-            slo_classes=[r.slo for r in live])
+            slo_classes=[r.slo for r in live],
+            rows_per_request=rows_each)
         stale = (self._staleness(served_ver) if self.tracer.enabled
                  else 0)  # constant across the group: look up once
         for req, q_s in zip(live, queue_waits):

@@ -90,6 +90,21 @@ evaluation (per-class attainment + burn rate) and the device
 attribution (the XLA-queue split on device hosts, the honest
 ``source="none"`` fallback on CPU).
 
+The ISSUE 13 continuous-batching leg (``continuous_batching``, schema
+BENCH_SERVE.v6) prices the serving loop's rewrite paired: the
+fixed-drain micro-batcher over the hand-picked ladder vs continuous
+admission over a ladder LEARNED from the baseline leg's own
+``serve_request_rows`` registry series (``serving/ladder.py`` —
+bounded program count, explicit pad-waste cost model, recompile budget
+charged per installed rung). New rungs are pre-warmed and installed
+off the serving thread under live traffic, the learner freezes, and
+the paired legs replay one seeded open-loop arrival schedule
+(``bench_common.open_loop_offsets``) at ``SERVE_CB_LOAD`` x measured
+capacity. Zero recompiles after freeze and exactly-once spans are
+abort-grade; the headline mixed stream is ALSO open-loop paced now
+(``SERVE_PACE_FACTOR`` x a closed-loop calibration), so its queue
+percentiles measure service under load rather than backlog drain.
+
 Env knobs: SERVE_BUCKETS ("1,8,64,512"), SERVE_D (RFF width, 256),
 SERVE_N (train rows, 4096), SERVE_CLIENTS (8), SERVE_TRAIN_ROUNDS (2),
 SERVE_ITERS (per-bucket timed calls, 30), SERVE_REQUESTS (mixed-stream
@@ -100,7 +115,17 @@ length, default max(SERVE_REQUESTS, 120) — long enough that the
 scripted per-replica kill indices land mid-stream), SERVE_CKPT (serve
 an existing checkpoint dir instead
 of training), SERVE_TELEMETRY_REPS (paired telemetry-plane legs,
-default 5), SERVE_DEVATTR_REPS (profiled dispatches in the
+default 5), SERVE_PACE_FACTOR (headline-stream arrival rate as a
+fraction of calibrated capacity, default 0.8), SERVE_CB_REQUESTS
+(continuous-batching leg stream length, default max(2 x
+SERVE_REQUESTS, 600)), SERVE_CB_LOAD (paired-leg arrival rate as a
+fraction of the fixed-drain closed-loop calibration, default 0.35 —
+the sub-saturation SLO regime; at saturation both policies converge
+to full-ladder batches and the comparison measures queue depth, not
+policy), SERVE_CB_REPS (paired continuous-batching reps, best-of per
+mode, default 5), SERVE_CB_RUNGS (learned-ladder
+program budget, default 6), SERVE_CB_BUDGET (learner recompile
+budget, default 6), SERVE_DEVATTR_REPS (profiled dispatches in the
 device-attribution probe, default 6),
 SERVE_OUT, SERVE_ROUND (artifact suffix, default 1),
 SERVE_TRACE (directory: export the traced leg's span records as JSONL
@@ -114,6 +139,7 @@ capture of the timed section, shared with bench.py via
 bench_common.profile_ctx).
 """
 
+import gc
 import json
 import os
 import shutil
@@ -195,48 +221,98 @@ def time_bucket(engine, b: int, iters: int, rng) -> dict:
     return out
 
 
+def stream_sizes(buckets, n_requests: int, rng) -> list:
+    """The deterministic mixed-size recipe: single rows plus every
+    rung boundary's neighborhood, permuted — each compiled bucket
+    serves real (non-warmup) traffic."""
+    sizes = []
+    for b in buckets:
+        sizes += [1, max(1, b // 2), b]
+    return [sizes[i % len(sizes)] for i in rng.permutation(
+        max(n_requests, len(sizes)))[:n_requests]]
+
+
 def mixed_stream(engine, n_requests: int, max_wait_ms: float, rng,
-                 tracer=None, metrics=None, slo_classes=None) -> dict:
+                 tracer=None, metrics=None, slo_classes=None,
+                 pace_rps: float | None = None, pace_seed: int = 0,
+                 mode: str = "continuous", sizes=None) -> dict:
     """Drive a deterministic mixed-size request stream through the full
     service loop and snapshot its metrics (now including the per-stage
-    queue/pad/device percentile families). Sizes mix single rows with
-    every rung boundary's neighborhood so each compiled bucket serves
-    real (non-warmup) traffic. ``tracer``: a live ``utils.trace``
-    Tracer for the traced leg (every accepted request lands one
-    "request" span); None keeps the no-op default. ``metrics``: a
-    prepared ``ServeMetrics`` (the telemetry leg passes one whose
-    registry is enabled or disabled — the paired plane-on/off
+    queue/pad/device percentile families). ``tracer``: a live
+    ``utils.trace`` Tracer for the traced leg (every accepted request
+    lands one "request" span); None keeps the no-op default.
+    ``metrics``: a prepared ``ServeMetrics`` (the telemetry leg passes
+    one whose registry is enabled or disabled — the paired plane-on/off
     comparison); ``slo_classes``: a cycle of SLO class labels stamped
-    on submits, so the per-class latency family carries real traffic."""
+    on submits, so the per-class latency family carries real traffic.
+
+    ``pace_rps`` (ISSUE 13 satellite): open-loop SEEDED paced arrivals
+    at that mean rate (``bench_common.open_loop_offsets``) — queue
+    percentiles then measure service under load. None keeps the
+    closed-loop enqueue-everything shape, which measures max
+    throughput (what the paired overhead estimators need: under
+    pacing both legs would just report the arrival rate). ``mode``:
+    the service's batch-formation policy ("continuous" default,
+    "drain" = the fixed-micro-batch baseline). ``sizes``: explicit
+    request-size list (paired before/after legs share one); default
+    derives from the engine's CURRENT ladder via :func:`stream_sizes`.
+    """
+    from bench_common import open_loop_offsets
     from fedamw_tpu.serving import ServingService
 
-    sizes = []
-    for b in engine.buckets:
-        sizes += [1, max(1, b // 2), b]
-    sizes = [sizes[i % len(sizes)] for i in rng.permutation(
-        max(n_requests, len(sizes)))[:n_requests]]
+    if sizes is None:
+        sizes = stream_sizes(engine.buckets, n_requests, rng)
     payloads = [rng.randn(s, engine.input_dim).astype(np.float32)
                 for s in sizes]
-    t0 = time.perf_counter()
-    # the load generator enqueues far faster than the engine drains;
-    # max_queue must admit the whole configured stream or a large
-    # SERVE_REQUESTS would crash with Overloaded instead of measuring
-    with ServingService(engine, max_wait_ms=max_wait_ms,
-                        max_queue=max(1024, n_requests),
-                        tracer=tracer, metrics=metrics) as svc:
-        futures = [
-            svc.submit(x, slo_class=(
-                slo_classes[i % len(slo_classes)] if slo_classes
-                else None))
-            for i, x in enumerate(payloads)]
-        for f in futures:
-            f.result(timeout=300)
-        dt = time.perf_counter() - t0
-        snap = svc.metrics.snapshot(engine)
+    offsets = None
+    if pace_rps is not None:
+        offsets = open_loop_offsets(np.random.RandomState(pace_seed),
+                                    len(payloads), pace_rps)
+    # collect BEFORE timing and hold GC off DURING the stream: paired
+    # overhead legs run back to back, so monotonically-growing heap
+    # garbage would systematically tax whichever leg runs second (a
+    # collection pause mid-stream also reads as a fake multi-ms tail
+    # in the paced sub-5ms p95 regime). The stream's own garbage is
+    # bounded — a few hundred request records — and collected at the
+    # next stream's entry.
+    gc.collect()
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        # the load generator enqueues far faster than the engine
+        # drains; max_queue must admit the whole configured stream or
+        # a large SERVE_REQUESTS would crash with Overloaded instead
+        # of measuring
+        with ServingService(engine, max_wait_ms=max_wait_ms,
+                            max_queue=max(1024, len(payloads)),
+                            tracer=tracer, metrics=metrics,
+                            mode=mode) as svc:
+            futures = []
+            for i, x in enumerate(payloads):
+                if offsets is not None:
+                    # absolute offsets, not per-gap sleeps: submit-
+                    # side overhead never compresses the schedule
+                    lag = t0 + offsets[i] - time.perf_counter()
+                    if lag > 0:
+                        time.sleep(lag)
+                futures.append(svc.submit(x, slo_class=(
+                    slo_classes[i % len(slo_classes)] if slo_classes
+                    else None)))
+            for f in futures:
+                f.result(timeout=300)
+            dt = time.perf_counter() - t0
+            snap = svc.metrics.snapshot(engine)
+    finally:
+        # in a finally: a failed stream must not leave collection off
+        # for the rest of the process
+        gc.enable()
     # end-to-end wall-clock throughput (the metrics-internal rate spans
     # batch completions only and is None for a single-batch stream)
     snap["throughput_req_per_s"] = round(len(payloads) / dt, 2)
     snap["throughput_rows_per_s"] = round(sum(sizes) / dt, 2)
+    snap["mode"] = mode
+    snap["arrival_req_per_s"] = (None if pace_rps is None
+                                 else round(float(pace_rps), 2))
     return snap
 
 
@@ -792,6 +868,206 @@ def telemetry_bench(engine, n_requests, max_wait_ms):
     return section
 
 
+def continuous_batching_bench(ckpt, buckets, max_wait_ms):
+    """The ISSUE 13 leg: continuous batching over a traffic-learned
+    ladder, measured PAIRED against the fixed-drain baseline it
+    replaces, on its own engine (the shared engine's compile counters
+    stay untouched). One seeded open-loop arrival schedule, five
+    steps:
+
+    1. closed-loop calibration (drain mode, fixed ladder) measures the
+       capacity the paced legs are loaded against;
+    2. BASELINE reps: fixed ladder + drain-mode batching, open-loop
+       paced at ``SERVE_CB_LOAD`` x calibration — their shared live
+       registry records the ``serve_request_rows`` histogram series;
+    3. a ``LadderLearner`` proposes a rung set from that series
+       (bounded program count, explicit pad-waste cost model,
+       recompile budget charged per installed rung); the new rungs
+       are PRE-WARMED and installed from this thread while a
+       continuous-mode service serves a live trickle — re-bucketing
+       never compiles on the serving thread — then the learner
+       freezes;
+    4. CONTINUOUS reps: the same paced schedule and request sizes
+       through continuous admission over the learned ladder;
+    5. best-of-reps per mode (the paired estimator every overhead leg
+       uses: min p95 per mode over ``SERVE_CB_REPS`` alternating
+       reps, so a ~hundreds-of-ms stream's scheduler noise does not
+       masquerade as policy). BOTH legs of every rep run traced, so
+       the policies pay identical observability cost and the winning
+       continuous rep doubles as the exactly-once-span evidence.
+
+    Abort-grade pins, like parity: zero recompiles after ladder
+    freeze, every request of every continuous rep landing exactly one
+    span, and no request failed in any leg. The headline comparison
+    (p95 baseline / p95 continuous) is recorded; below 2x it prints a
+    loud warning (the committed-capture expectation) but does not
+    abort — a loaded box must not flake the contract test on
+    scheduler noise. Returns the artifact ``continuous_batching``
+    section (BENCH_SERVE.v6)."""
+    from fedamw_tpu.serving import (LadderLearner, ServeMetrics,
+                                    ServingEngine, ServingService,
+                                    apply_proposal)
+    from fedamw_tpu.utils.telemetry import Registry
+    from fedamw_tpu.utils.trace import Tracer
+
+    n = _env_int("SERVE_CB_REQUESTS",
+                 max(2 * _env_int("SERVE_REQUESTS", 200), 600))
+    load = float(os.environ.get("SERVE_CB_LOAD", "0.35"))
+    reps = max(1, _env_int("SERVE_CB_REPS", 5))
+    max_rungs = _env_int("SERVE_CB_RUNGS", 6)
+    budget = _env_int("SERVE_CB_BUDGET", 6)
+
+    # TWO engines from one checkpoint: the baseline keeps the fixed
+    # ladder for the whole leg, the continuous engine learns — so the
+    # paired reps can ALTERNATE modes (a noisy-neighbor slow phase
+    # lands on both legs, the same reason the trace/telemetry
+    # estimators pair theirs) instead of measuring the modes in
+    # disjoint time windows
+    eng_base = ServingEngine.load(ckpt, buckets=buckets)
+    eng_base.warmup()
+    eng_cont = ServingEngine.load(ckpt, buckets=buckets)
+    eng_cont.warmup()
+    fixed = tuple(eng_base.buckets)
+    size_rng = np.random.RandomState(23)
+    sizes = stream_sizes(fixed, n, size_rng)
+
+    def leg(engine, mode, pace=None, metrics=None, tracer=None):
+        # mixed_stream holds GC off for the timed stream (see there)
+        return mixed_stream(engine, n, max_wait_ms,
+                            np.random.RandomState(29),
+                            tracer=tracer, metrics=metrics,
+                            pace_rps=pace, pace_seed=31, mode=mode,
+                            sizes=sizes)
+
+    # 1) capacity calibration: closed loop, series-off registry (the
+    # calibration must not pollute the learner's evidence)
+    cal = leg(eng_base, "drain", metrics=ServeMetrics(
+        registry=Registry(enabled=False)))
+    rate = round(load * cal["throughput_req_per_s"], 2)
+
+    # 2) the evidence leg: one fixed-drain paced run whose live
+    # registry records the request-rows series the learner reads
+    m_evidence = ServeMetrics()
+    leg(eng_base, "drain", pace=rate, metrics=m_evidence)
+
+    # 3) learn, install on the CONTINUOUS engine (pre-warmed off the
+    # serving thread, under live continuous traffic), freeze
+    learner = LadderLearner(m_evidence.registry, max_rungs=max_rungs,
+                            recompile_budget=budget, min_samples=32)
+    proposal = learner.propose(fixed)
+    trickle_errors: list = []
+    if proposal is not None:
+        stop = threading.Event()
+        with ServingService(eng_cont, max_wait_ms=max_wait_ms,
+                            mode="continuous") as svc:
+            def trickle():
+                k = 0
+                try:
+                    while not stop.is_set():
+                        svc.submit(size_rng.randn(
+                            sizes[k % len(sizes)],
+                            eng_cont.input_dim).astype(
+                                np.float32)).result(timeout=60)
+                        k += 1
+                except Exception as e:  # surfaced after join, below
+                    trickle_errors.append(e)
+
+            th = threading.Thread(target=trickle, name="cb-trickle")
+            th.start()
+            try:
+                # THIS thread pre-warms and installs each rung while
+                # the worker keeps dispatching the old ladder through
+                # the live trickle — the off-hot-path re-bucketing the
+                # zero-recompile-after-freeze pin certifies
+                apply_proposal(eng_cont, proposal, learner)
+            finally:
+                stop.set()
+                th.join(timeout=60)
+    learner.freeze()
+    cc_freeze = eng_cont.compile_count
+
+    # 4) ALTERNATING paired reps — fixed-drain on the fixed engine,
+    # continuous on the learned one, back to back within each rep;
+    # best-of-reps per mode. Every continuous rep is traced and every
+    # rep's spans are pinned exactly-once.
+    base = cont = None
+    spans_once = True
+    for _ in range(reps):
+        snap = leg(eng_base, "drain", pace=rate,
+                   metrics=ServeMetrics(),
+                   tracer=Tracer(max_spans=4 * n + 64))
+        if base is None or snap["p95_ms"] < base["p95_ms"]:
+            base = snap
+        tracer = Tracer(max_spans=4 * n + 64)
+        snap = leg(eng_cont, "continuous", pace=rate,
+                   metrics=ServeMetrics(), tracer=tracer)
+        ids = [r["trace_id"] for r in tracer.records()
+               if r["name"] == "request"]
+        spans_once = spans_once and (
+            len(ids) == n and len(set(ids)) == len(ids)
+            and tracer.dropped == 0)
+        if cont is None or snap["p95_ms"] < cont["p95_ms"]:
+            cont = snap
+    recompiles = eng_cont.compile_count - cc_freeze
+
+    def _sub(snap):
+        out = {k: snap[k] for k in (
+            "requests", "batches", "mean_batch_rows", "p50_ms",
+            "p95_ms", "p99_ms", "queue_depth_peak",
+            "throughput_req_per_s", "mode")}
+        for stage in ("queue", "pad", "device"):
+            for q in ("p50_ms", "p95_ms", "p99_ms"):
+                out[f"{stage}_{q}"] = snap[f"{stage}_{q}"]
+        return out
+
+    improvement = (round(base["p95_ms"] / cont["p95_ms"], 2)
+                   if base["p95_ms"] and cont["p95_ms"] else None)
+    section = {
+        "requests_per_leg": n,
+        "reps": reps,
+        "load_factor": load,
+        "calibration_req_per_s": cal["throughput_req_per_s"],
+        "arrival_req_per_s": rate,
+        "baseline": _sub(base),
+        "continuous": _sub(cont),
+        "ladder": {
+            "fixed": list(fixed),
+            "learned": list(eng_cont.buckets),
+            "installed": list(proposal.install) if proposal else [],
+            "retired": list(proposal.retire) if proposal else [],
+            "max_rungs": max_rungs,
+            "recompile_budget": budget,
+            "recompiles_charged": learner.recompiles_spent,
+            "frozen": learner.frozen,
+            "sample_rows": (proposal.sample_count if proposal else 0),
+            "waste_fraction_fixed": (
+                proposal.baseline_waste_fraction if proposal else None),
+            "waste_fraction_learned": (
+                proposal.waste_fraction if proposal else None),
+            "skipped_reason": (None if proposal else learner.last_reason),
+        },
+        "p95_improvement_x": improvement,
+        "recompiles_after_freeze": recompiles,
+        "spans_exactly_once": spans_once,
+    }
+    if (recompiles or not spans_once or improvement is None
+            or trickle_errors):
+        # abort-grade, like parity: a compile after the ladder froze,
+        # a lost/duplicated span, a failed in-flight request during
+        # install, or a leg with no measurable tail must never emit
+        # green-looking improvement numbers
+        if trickle_errors:
+            section["install_error"] = repr(trickle_errors[0])
+        print(f"# serve_bench aborted: continuous-batching leg failed "
+              f"({json.dumps(section)})", file=sys.stderr)
+        raise SystemExit(1)
+    if improvement < 2.0:
+        print(f"# WARNING: continuous batching measured only "
+              f"{improvement}x p95 vs the fixed-drain baseline (the "
+              "committed-capture expectation is >= 2x at high load)",
+              file=sys.stderr)
+    return section
+
 def main():
     # shared prologue with bench.py (bench_common): re-apply
     # JAX_PLATFORMS over the container's sitecustomize, then the
@@ -871,6 +1147,30 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
     from fedamw_tpu.utils.reporting import format_trace_summary
     from fedamw_tpu.utils.trace import Tracer
 
+    # ISSUE 13: the continuous-batching leg — fixed-drain baseline vs
+    # continuous admission over a ladder learned from the baseline's
+    # own request-size series, paired on one seeded open-loop
+    # schedule; zero recompiles after ladder freeze and exactly-once
+    # spans are abort-grade. Runs on its OWN engine, so the shared
+    # engine's zero-recompile pin below is untouched by the installs.
+    # Runs FIRST of the legs, on a fresh heap: its paired tails live
+    # in a sub-5ms regime where the later legs' accumulated garbage
+    # (dead engines, artifacts, tracers) turns collection pauses
+    # into fake multi-ms p95 samples.
+    t_cb0 = time.perf_counter()
+    cb = continuous_batching_bench(ckpt, tuple(engine.buckets),
+                                   max_wait_ms)
+    cb_s = time.perf_counter() - t_cb0
+    print(f"# continuous batching: {cb['p95_improvement_x']}x p95 vs "
+          f"fixed drain ({cb['baseline']['p95_ms']}ms -> "
+          f"{cb['continuous']['p95_ms']}ms at "
+          f"{cb['arrival_req_per_s']} req/s; ladder "
+          f"{cb['ladder']['fixed']} -> {cb['ladder']['learned']}, "
+          f"{cb['ladder']['recompiles_charged']} recompiles charged, "
+          f"{cb['recompiles_after_freeze']} after freeze)",
+          file=sys.stderr)
+
+
     rng = np.random.RandomState(0)
     bucket_latency = {}
     t_timed0 = time.perf_counter()
@@ -886,7 +1186,22 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
                   f"{rec['throughput_rows_per_s']} rows/s",
                   file=sys.stderr)
 
-        stream = mixed_stream(engine, n_requests, max_wait_ms, rng)
+        # the headline mixed stream is OPEN-LOOP since ISSUE 13: a
+        # closed-loop calibration measures capacity, then seeded paced
+        # arrivals at SERVE_PACE_FACTOR x that capacity drive the
+        # measured stream — queue percentiles now describe service
+        # under load, not backlog drain (the old shape enqueued the
+        # whole stream first, so queue_depth_peak == requests and the
+        # queue family measured a different quantity)
+        pace_factor = float(os.environ.get("SERVE_PACE_FACTOR", "0.8"))
+        cal = mixed_stream(engine, max(n_requests, 200), max_wait_ms,
+                           rng)
+        stream = mixed_stream(
+            engine, n_requests, max_wait_ms, rng,
+            pace_rps=round(pace_factor * cal["throughput_req_per_s"],
+                           2))
+        stream["calibration_req_per_s"] = cal["throughput_req_per_s"]
+        stream["pace_factor"] = pace_factor
 
         # traced twin of the mixed stream (ISSUE 5): the tracing cost
         # as BEST-of-reps over PAIRED legs. Pairing matters twice:
@@ -1017,12 +1332,13 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
 
     artifact = {
         "metric": "serve_bench",
-        # v5: the telemetry_overhead section (unified telemetry
-        # plane) joins the v4 cold_start, v3 chaos, and v2 rollout
-        # sections in the contract — tools/check_bench_schema.py
-        # requires each from its version on (earlier artifacts are
-        # grandfathered by schema version)
-        "schema": "BENCH_SERVE.v5",
+        # v6: the continuous_batching section (learned-ladder
+        # continuous batching) joins the v5 telemetry_overhead, v4
+        # cold_start, v3 chaos, and v2 rollout sections in the
+        # contract — tools/check_bench_schema.py requires each from
+        # its version on (earlier artifacts are grandfathered by
+        # schema version)
+        "schema": "BENCH_SERVE.v6",
         "platform": platform,
         "engine": {
             "buckets": list(engine.buckets),
@@ -1040,6 +1356,7 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
                    "chaos_s": round(chaos_s, 3),
                    "cold_start_s": round(cold_s, 3),
                    "telemetry_s": round(telemetry_s, 3),
+                   "continuous_batching_s": round(cb_s, 3),
                    # None when BENCH_COMPILE_CACHE is unset (cold by
                    # construction); else dir + entry counts, so a
                    # warm-cache compile_warmup_s can never be read as
@@ -1052,6 +1369,7 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
         "chaos": chaos,
         "cold_start": cold,
         "telemetry_overhead": telemetry,
+        "continuous_batching": cb,
         "trace": {
             "request_spans": len(req_spans),
             "unique_request_ids": len(set(ids)),
@@ -1077,10 +1395,27 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
         json.dump(artifact, f, indent=1)
     print(f"# artifact -> {out_path}", file=sys.stderr)
 
-    # the telemetry-plane line (FIRST of the leg lines, so the
-    # chaos/rollout/cold-start/trace line positions the contract test
-    # pins are unmoved; headline still LAST): what the whole
-    # observability plane costs, and whether the device split landed
+    # the continuous-batching line (FIRST of the leg lines — each new
+    # leg prepends, so every existing line position the contract test
+    # pins is unmoved and the headline stays LAST): the paired p95
+    # improvement over the fixed-drain baseline, the learned ladder,
+    # and the zero-recompile-after-freeze pin
+    print(json.dumps({
+        "metric": "serve_continuous_batching",
+        "value": cb["p95_improvement_x"],
+        "unit": "x-p95-vs-fixed-drain",
+        "baseline_p95_ms": cb["baseline"]["p95_ms"],
+        "continuous_p95_ms": cb["continuous"]["p95_ms"],
+        "arrival_req_per_s": cb["arrival_req_per_s"],
+        "ladder": cb["ladder"]["learned"],
+        "recompiles_after_freeze": cb["recompiles_after_freeze"],
+        "spans_exactly_once": cb["spans_exactly_once"],
+        "platform": platform,
+    }))
+
+    # the telemetry-plane line (before the headline, which stays
+    # LAST): what the whole observability plane costs, and whether the
+    # device split landed
     print(json.dumps({
         "metric": "serve_telemetry_overhead",
         "value": telemetry["overhead_x"],

@@ -344,6 +344,89 @@ def test_serve_v5_rejects_telemetry_drift(tmp_path):
     assert cbs.validate_file(p) == []
 
 
+GOOD_CB_LEG = {
+    "requests": 400, "batches": 180, "mean_batch_rows": 140.0,
+    "p50_ms": 1.4, "p95_ms": 3.1, "p99_ms": 4.0,
+    "queue_depth_peak": 9, "throughput_req_per_s": 1300.0,
+}
+
+GOOD_CONTINUOUS = {
+    "requests_per_leg": 400, "reps": 3, "load_factor": 0.45,
+    "calibration_req_per_s": 2900.0, "arrival_req_per_s": 1305.0,
+    "baseline": dict(GOOD_CB_LEG, p95_ms=6.5, mode="drain"),
+    "continuous": dict(GOOD_CB_LEG, mode="continuous"),
+    "ladder": {"fixed": [1, 8, 64, 512],
+               "learned": [1, 8, 32, 64, 256, 512],
+               "installed": [32, 256], "retired": [],
+               "max_rungs": 6, "recompile_budget": 6,
+               "recompiles_charged": 2, "frozen": True,
+               "sample_rows": 1200, "waste_fraction_fixed": 0.61,
+               "waste_fraction_learned": 0.12},
+    "p95_improvement_x": 2.1,
+    "recompiles_after_freeze": 0,
+    "spans_exactly_once": True,
+}
+
+
+def _serve_art_v6(**extra):
+    art = _serve_art(schema="BENCH_SERVE.v6",
+                     chaos=dict(GOOD_CHAOS_V4),
+                     cold_start=dict(GOOD_COLD),
+                     telemetry_overhead=dict(GOOD_TELEMETRY),
+                     continuous_batching=dict(GOOD_CONTINUOUS))
+    art.update(extra)
+    return art
+
+
+def test_serve_v6_requires_continuous_batching_section(tmp_path):
+    """From schema v6 on, the learned-ladder continuous-batching
+    leg's section is contract; v5 artifacts predate it and stay
+    valid."""
+    assert cbs.validate_file(
+        _write(tmp_path, "BENCH_SERVE_r09.json", _serve_art_v6())) == []
+    art = _serve_art_v6()
+    del art["continuous_batching"]
+    errs = cbs.validate_file(_write(tmp_path, "BENCH_SERVE_r09.json",
+                                    art))
+    assert any("'continuous_batching' section" in e for e in errs)
+    # v5 stays valid without the section (pre-ISSUE-13 shape)
+    v5 = _serve_art_v5()
+    assert cbs.validate_file(
+        _write(tmp_path, "BENCH_SERVE_r09.json", v5)) == []
+
+
+def test_serve_v6_rejects_continuous_batching_drift(tmp_path):
+    # both paired legs, measured, with a recorded improvement
+    for key, bad in (("baseline", None),
+                     ("continuous", None),
+                     ("baseline", dict(GOOD_CB_LEG, p95_ms=0)),
+                     ("continuous", dict(GOOD_CB_LEG, requests=0)),
+                     ("p95_improvement_x", None),
+                     ("p95_improvement_x", 0),
+                     ("ladder", {}),
+                     ("ladder", {"learned": []})):
+        cb = dict(GOOD_CONTINUOUS)
+        if bad is None and key in ("baseline", "continuous"):
+            del cb[key]
+        else:
+            cb[key] = bad
+        p = _write(tmp_path, "BENCH_SERVE_r09.json",
+                   _serve_art_v6(continuous_batching=cb))
+        assert cbs.validate_file(p), \
+            f"accepted broken continuous_batching {key}={bad!r}"
+    # the abort-grade pins, re-checked at the gate: a post-freeze
+    # compile or a lost span must never land in a committed artifact
+    p = _write(tmp_path, "BENCH_SERVE_r09.json", _serve_art_v6(
+        continuous_batching=dict(GOOD_CONTINUOUS,
+                                 recompiles_after_freeze=1)))
+    assert any("never compile on the hot path" in e
+               for e in cbs.validate_file(p))
+    p = _write(tmp_path, "BENCH_SERVE_r09.json", _serve_art_v6(
+        continuous_batching=dict(GOOD_CONTINUOUS,
+                                 spans_exactly_once=False)))
+    assert any("spans_exactly_once" in e for e in cbs.validate_file(p))
+
+
 def test_rejects_multichip_ok_rc_disagreement(tmp_path):
     p = _write(tmp_path, "MULTICHIP_r09.json",
                {"n_devices": 8, "rc": 124, "ok": True, "tail": "OK"})

@@ -200,6 +200,79 @@ def test_gl003_flags_device_sync_in_hot_path(tmp_path):
     assert len(findings) == 2
 
 
+def test_gl002_flags_shape_keyed_cache_in_ladder_learner(tmp_path):
+    """The ISSUE 13 hot-path extension: the ladder learner's read path
+    is polled against live traffic, and a shape-keyed cache there is
+    the exact recompile-hazard pattern the learned ladder exists to
+    remove — GL002 must catch it."""
+    findings, _ = lint_src(tmp_path, """
+        class LadderLearner:
+            def propose(self, current, X):
+                self._cache[X.shape] = current
+                self._seen.add(X.dtype)
+                return current
+    """, name="serving/ladder.py")
+    assert rules_of(findings) == ["GL002"]
+    assert len(findings) == 2
+
+
+def test_gl002_ladder_learner_near_miss_stays_silent(tmp_path):
+    # the REAL learner's shape: integer row-count samples from the
+    # registry series, no array shapes anywhere near a cache key —
+    # and shapes in raise messages stay blessed
+    findings, _ = lint_src(tmp_path, """
+        class LadderLearner:
+            def observed_sizes(self):
+                return [int(v) for v in self.registry.values()]
+
+            def propose(self, current, X=None):
+                sizes = self.observed_sizes()
+                if X is not None and X.ndim != 2:
+                    raise ValueError(f"bad payload {X.shape}")
+                return tuple(sorted(set(sizes)))
+    """, name="serving/ladder.py")
+    assert findings == []
+
+
+def test_gl003_flags_host_sync_in_admission_loop(tmp_path):
+    """The ISSUE 13 hot-path extension: the continuous-admission loop
+    runs once per dispatch on the worker thread — a device sync inside
+    it is a per-batch stall GL003 must catch."""
+    findings, _ = lint_src(tmp_path, """
+        import numpy as np
+
+        def admit(q, seed, engine):
+            out = engine.predict(seed)
+            out.block_until_ready()
+            return np.asarray(out)
+    """, name="serving/batcher.py")
+    assert rules_of(findings) == ["GL003"]
+    assert len(findings) == 2
+
+
+def test_gl003_admission_loop_near_miss_stays_silent(tmp_path):
+    # the REAL admit: queue ops and row arithmetic only — no device
+    # values in sight (np work on the request PAYLOADS is host->host)
+    findings, _ = lint_src(tmp_path, """
+        import queue
+
+        def admit(q, seed, max_rows):
+            batch = list(seed) if isinstance(seed, list) else [seed]
+            rows = sum(r.rows for r in batch)
+            while rows < max_rows:
+                try:
+                    nxt = q.get_nowait()
+                except queue.Empty:
+                    break
+                if rows + nxt.rows > max_rows:
+                    return batch, nxt
+                batch.append(nxt)
+                rows += nxt.rows
+            return batch, None
+    """, name="serving/batcher.py")
+    assert findings == []
+
+
 def test_gl003_near_misses_stay_silent(tmp_path):
     # converting the INPUT (host->host) is fine; so is converting a
     # dispatch result outside the hot-path set

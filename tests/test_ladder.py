@@ -1,0 +1,480 @@
+"""The learned-ladder plane (ISSUE 13): rung learning properties,
+recompile-budget accounting, atomic install/retire on a live engine,
+and continuous-batching admission.
+
+The load-bearing guarantees: (1) ``learn_ladder`` is optimal under its
+explicit pad-waste cost model — rung count within the program budget,
+monotone rungs, the top rung covering the observed max, and sampled
+waste never above the hand-picked ``1/8/64/512/4096`` ladder's when
+the budget allows at least as many rungs; (2) the recompile budget is
+a hard pin — each installed rung is charged, an exhausted learner is
+FROZEN and proposes nothing, and overdrawing raises; (3)
+``install_rung`` pre-warms on the CALLER's thread and publishes
+atomically, so concurrent live traffic sees zero hot-path compiles
+and a consistent ladder at every dispatch; (4) the continuous
+admission policy (``batcher.admit``) never waits, never splits a
+request, and hands the over-budget request back as the holdover.
+"""
+
+import queue as queue_mod
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedamw_tpu.serving import (LadderLearner, ServeMetrics,
+                                ServingEngine, ServingService, admit,
+                                apply_proposal, ladder_waste,
+                                learn_ladder)
+from fedamw_tpu.utils.telemetry import Registry
+
+FIXED = (1, 8, 64, 512, 4096)
+
+
+def _engine(buckets=(1, 8, 64), d=16, C=3, seed=6):
+    rng = np.random.RandomState(seed)
+    return ServingEngine({"w": rng.randn(C, d).astype(np.float32)},
+                         buckets=buckets)
+
+
+# -- learn_ladder properties ------------------------------------------
+
+def _random_sizes(rng, n=400):
+    pool = [1, 2, 3, 7, 9, 17, 33, 50, 100, 250, 300, 700, 1500]
+    probs = rng.dirichlet(np.ones(len(pool)))
+    return [int(s) for s in rng.choice(pool, size=n, p=probs)]
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("budget", [1, 2, 4, 6, 10])
+def test_learned_ladder_properties(seed, budget):
+    """Rung count within the program budget, strictly monotone rungs,
+    top rung == observed max — for arbitrary samples and budgets."""
+    sizes = _random_sizes(np.random.RandomState(seed))
+    rungs = learn_ladder(sizes, budget)
+    assert 1 <= len(rungs) <= budget
+    assert list(rungs) == sorted(set(rungs))  # strictly increasing
+    assert rungs[-1] == max(sizes)  # every sampled request fits
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_learned_waste_never_above_fixed_ladder(seed):
+    """With a budget at least the fixed ladder's rung count, the
+    DP-learned ladder's sampled pad waste is <= the hand-picked
+    ``1/8/64/512/4096`` ladder's — the optimality property that makes
+    learning worth its recompiles."""
+    sizes = _random_sizes(np.random.RandomState(100 + seed))
+    rungs = learn_ladder(sizes, max_rungs=len(FIXED))
+    assert ladder_waste(sizes, rungs)["waste_rows"] <= \
+        ladder_waste(sizes, FIXED)["waste_rows"]
+
+
+def test_learn_ladder_is_optimal_against_brute_force():
+    import itertools
+
+    sizes = [1, 2, 2, 5, 9, 9, 9, 14, 30, 30]
+    cand = sorted(set(sizes))
+    for budget in (1, 2, 3, 4):
+        best = min(
+            ladder_waste(sizes, c)["waste_rows"]
+            for k in range(1, budget + 1)
+            for c in itertools.combinations(cand, k)
+            if c[-1] == max(sizes))
+        got = learn_ladder(sizes, budget)
+        assert ladder_waste(sizes, got)["waste_rows"] == best
+
+
+def test_program_cost_prices_rungs_explicitly():
+    """The explicit cost model: with a high enough per-program price,
+    the learner stops minting rungs for marginal padding savings."""
+    sizes = [1] * 50 + [2] * 2 + [64] * 50
+    free = learn_ladder(sizes, 3, program_cost=0.0)
+    priced = learn_ladder(sizes, 3, program_cost=1000.0)
+    assert len(priced) < len(free)
+    assert priced[-1] == free[-1] == 64
+
+
+def test_ladder_waste_chunks_oversized_at_top_rung():
+    """Sizes above the top rung chunk there (full chunks are exact,
+    only the remainder pads) — mirroring ServingEngine.predict."""
+    w = ladder_waste([10], (4, 8))  # 8 + pad(2 -> 4): 2 waste rows
+    assert w["waste_rows"] == 2 and w["padded_rows"] == 12
+    assert ladder_waste([16], (4, 8))["waste_rows"] == 0
+    with pytest.raises(ValueError, match="positive"):
+        ladder_waste([0], (4, 8))
+    with pytest.raises(ValueError, match="at least one"):
+        learn_ladder([], 3)
+
+
+# -- learner: evidence, budget accounting, freeze ---------------------
+
+def _metrics_with_traffic(sizes):
+    m = ServeMetrics()
+    for s in sizes:
+        m.record_batch(n_requests=1, n_rows=s, latencies=[1e-4],
+                       rows_per_request=[s])
+    return m
+
+
+def test_learner_reads_request_rows_series_and_proposes():
+    sizes = [1, 3, 3, 5, 24, 24] * 20
+    m = _metrics_with_traffic(sizes)
+    learner = LadderLearner(m.registry, max_rungs=4,
+                            recompile_budget=8, min_samples=32)
+    assert sorted(set(learner.observed_sizes())) == [1, 3, 5, 24]
+    prop = learner.propose((1, 8, 64))
+    assert prop is not None
+    assert prop.rungs[-1] == 24 and len(prop.rungs) <= 4
+    assert prop.sample_count == len(sizes)
+    # the explicit cost evidence: learning must beat the current
+    # ladder on the very sample it learned from
+    assert prop.waste_fraction < prop.baseline_waste_fraction
+    assert prop.recompiles_charged == len(prop.install)
+    assert set(prop.install).isdisjoint((1, 8, 64))
+    assert set(prop.retire) <= {1, 8, 64}
+
+
+def test_learner_needs_evidence_and_respects_min_samples():
+    m = _metrics_with_traffic([1, 8])
+    learner = LadderLearner(m.registry, min_samples=64)
+    assert learner.propose((1, 8)) is None
+    assert "min_samples" in learner.last_reason
+    # a series-disabled registry records no evidence at all
+    m_off = ServeMetrics(registry=Registry(enabled=False))
+    m_off.record_batch(n_requests=1, n_rows=4, latencies=[1e-4],
+                       rows_per_request=[4])
+    assert LadderLearner(m_off.registry,
+                         min_samples=1).observed_sizes() == []
+
+
+def test_recompile_budget_is_a_hard_pin():
+    """Each install charges the budget; overdraw raises; an exhausted
+    learner is frozen and proposes nothing ever again."""
+    m = _metrics_with_traffic([1, 3, 3, 5, 24, 24] * 20)
+    learner = LadderLearner(m.registry, max_rungs=4, recompile_budget=2,
+                            min_samples=32)
+    prop = learner.propose((1, 8, 64))
+    if prop is not None:
+        # affordable proposal: spend it and the learner freezes
+        assert len(prop.install) <= 2
+        learner.charge(len(prop.install))
+    else:
+        # unaffordable: the reason names the budget
+        assert "budget" in learner.last_reason
+        learner.charge(2)
+    assert learner.recompiles_spent == 2
+    assert learner.budget_remaining == 0
+    assert learner.frozen is True
+    assert learner.propose((1, 8, 64)) is None
+    assert "frozen" in learner.last_reason
+    with pytest.raises(RuntimeError, match="budget exhausted"):
+        learner.charge(1)
+
+
+def test_freeze_is_explicit_and_final():
+    m = _metrics_with_traffic([1, 3, 24] * 20)
+    learner = LadderLearner(m.registry, min_samples=16)
+    assert learner.frozen is False
+    learner.freeze()
+    assert learner.frozen is True
+    assert learner.propose((1, 8)) is None
+
+
+def test_learner_declines_when_current_ladder_already_optimal():
+    sizes = [1, 8, 64] * 30
+    m = _metrics_with_traffic(sizes)
+    learner = LadderLearner(m.registry, max_rungs=3, min_samples=32)
+    assert learner.propose((1, 8, 64)) is None
+    assert learner.last_reason is not None
+
+
+# -- engine: atomic rung install/retire -------------------------------
+
+def test_install_rung_prewarms_and_serves_without_hot_compile():
+    engine = _engine(buckets=(1, 8))
+    warm = engine.warmup()
+    assert warm == 2
+    engine.install_rung(4)
+    assert engine.buckets == (1, 4, 8)
+    cc = engine.compile_count
+    assert cc == 3  # the install's ONE charged compile, paid upfront
+    rng = np.random.RandomState(0)
+    out = engine.predict(rng.randn(3, 16).astype(np.float32))
+    assert out.shape == (3, 3)
+    assert engine.compile_count == cc  # pre-warmed: dispatch is free
+    # duplicates and nonsense are refused
+    with pytest.raises(ValueError, match="already a ladder rung"):
+        engine.install_rung(4)
+    with pytest.raises(ValueError, match="positive"):
+        engine.install_rung(0)
+
+
+def test_retire_rung_keeps_programs_and_floor():
+    engine = _engine(buckets=(1, 8, 64))
+    engine.warmup()
+    cc = engine.compile_count
+    engine.retire_rung(8)
+    assert engine.buckets == (1, 64)
+    rng = np.random.RandomState(1)
+    # former rung-8 traffic pads up to 64 with zero recompiles (the
+    # compiled program for 8 stays cached but unused)
+    engine.predict(rng.randn(5, 16).astype(np.float32))
+    assert engine.compile_count == cc
+    with pytest.raises(KeyError):
+        engine.retire_rung(8)
+    engine.retire_rung(1)
+    with pytest.raises(ValueError, match="last rung"):
+        engine.retire_rung(64)
+
+
+def test_install_rung_on_artifact_engine_requires_aot():
+    """The cold-start plane's zero-compile contract survives
+    re-bucketing: an artifact-loaded engine refuses a compiling
+    install and accepts an AOT-supplied rung executable."""
+    engine = _engine(buckets=(1, 8))
+    engine._aot = {}  # artifact-loaded marker (from_artifact sets it)
+    with pytest.raises(ValueError, match="aot="):
+        engine.install_rung(4)
+
+    calls = []
+
+    def fake_rung(x, params, rff):
+        calls.append(int(x.shape[0]))
+        return engine._predict(x, params, rff)
+
+    engine.install_rung(4, aot=fake_rung)
+    assert engine.buckets == (1, 4, 8)
+    rng = np.random.RandomState(2)
+    engine.predict(rng.randn(3, 16).astype(np.float32))
+    assert calls == [4]  # served through the supplied executable
+
+
+def test_offthread_install_race_with_live_traffic():
+    """The pre-warm race pin: rungs install from another thread while
+    the service dispatches live traffic continuously — every request
+    resolves correctly, the ladder is consistent at every dispatch,
+    and the only compiles are the installs' own charged pre-warms
+    (zero on the serving hot path after the final install)."""
+    engine = _engine(buckets=(1, 8, 64))
+    engine.warmup()
+    rng = np.random.RandomState(3)
+    payloads = [rng.randn(k, 16).astype(np.float32)
+                for k in (1, 3, 5, 8, 13, 40)]
+    want = [engine.predict(x) for x in payloads]
+    stop = threading.Event()
+    errors: list = []
+    served = [0]
+
+    def pump(svc):
+        k = 0
+        try:
+            while not stop.is_set():
+                i = k % len(payloads)
+                out = svc.submit(payloads[i]).result(timeout=60)
+                np.testing.assert_array_equal(out, want[i])
+                served[0] += 1
+                k += 1
+        except Exception as e:
+            errors.append(e)
+
+    with ServingService(engine, mode="continuous") as svc:
+        th = threading.Thread(target=pump, args=(svc,))
+        th.start()
+        time.sleep(0.02)
+        for b in (4, 16, 32):
+            engine.install_rung(b)  # pre-warm + atomic publish, HERE
+        cc_after_installs = engine.compile_count
+        engine.retire_rung(64)
+        time.sleep(0.05)  # live traffic over the learned ladder
+        stop.set()
+        th.join(timeout=60)
+    assert errors == []
+    assert served[0] > 0
+    assert engine.buckets == (1, 4, 8, 16, 32)
+    # 3 warmup + 3 installs, and NOTHING after: the post-install
+    # traffic (including former rung-64 sizes padding to 8+32 chunks
+    # or 40 -> chunked) never compiled on the hot path
+    assert cc_after_installs == 6
+    assert engine.compile_count == 6
+
+
+def test_apply_proposal_charges_learner_and_updates_engine():
+    engine = _engine(buckets=(1, 8, 64))
+    engine.warmup()
+    m = _metrics_with_traffic([1, 3, 3, 5, 24, 24] * 20)
+    learner = LadderLearner(m.registry, max_rungs=4, recompile_budget=8,
+                            min_samples=32)
+    prop = learner.propose(engine.buckets)
+    assert prop is not None
+    ladder = apply_proposal(engine, prop, learner)
+    assert ladder == engine.buckets == prop.rungs
+    assert learner.recompiles_spent == len(prop.install)
+
+
+# -- continuous admission (batcher.admit) -----------------------------
+
+def test_admit_takes_queued_never_waits_and_hands_back_holdover():
+    q = queue_mod.Queue()
+    for k in (4, 3):
+        q.put(np.zeros((k, 8), np.float32))
+    t0 = time.perf_counter()
+    batch, held = admit(q, np.zeros((2, 8), np.float32), max_rows=8)
+    took = time.perf_counter() - t0
+    # 2 + 4 fit; the 3-row request would exceed 8 -> holdover (same
+    # contract as drain), and nothing ever lingered
+    assert [b.shape[0] for b in batch] == [2, 4]
+    assert held is not None and held.shape[0] == 3
+    assert took < 0.05
+    # empty queue: solo dispatch immediately, no holdover
+    t0 = time.perf_counter()
+    batch, held = admit(q, np.zeros((1, 8), np.float32), max_rows=8)
+    assert [b.shape[0] for b in batch] == [1] and held is None
+    assert time.perf_counter() - t0 < 0.05
+
+
+def test_service_modes_validated_and_drain_still_selectable():
+    engine = _engine()
+    with pytest.raises(ValueError, match="mode"):
+        ServingService(engine, mode="bogus")
+    rng = np.random.RandomState(4)
+    for mode in ("continuous", "drain"):
+        with ServingService(engine, mode=mode, max_wait_ms=1.0) as svc:
+            x = rng.randn(3, 16).astype(np.float32)
+            np.testing.assert_array_equal(
+                svc.submit(x).result(timeout=30), engine.predict(x))
+
+
+def test_worker_picks_up_installed_rungs_mid_stream():
+    """The worker re-reads the ladder per batch: a rung installed
+    mid-stream raises the admission cap without a service restart."""
+    engine = _engine(buckets=(1, 8))
+    engine.warmup()
+    rng = np.random.RandomState(5)
+    with ServingService(engine, mode="continuous") as svc:
+        svc.submit(rng.randn(2, 16).astype(np.float32)).result(
+            timeout=30)
+        engine.install_rung(32)
+        out = svc.submit(rng.randn(20, 16).astype(np.float32)).result(
+            timeout=30)
+        assert out.shape == (20, 3)
+    snap = svc.metrics.snapshot(engine)
+    assert snap["requests"] == 2
+
+
+def test_request_rows_series_lands_in_registry():
+    """The PR 12 signal the learner consumes: every served request's
+    row count is a sample on the serve_request_rows histogram series,
+    and every dispatch's total on serve_batch_rows."""
+    engine = _engine()
+    m = ServeMetrics()
+    rng = np.random.RandomState(7)
+    with ServingService(engine, metrics=m) as svc:
+        for k in (1, 4, 9):
+            svc.submit(rng.randn(k, 16).astype(np.float32)).result(
+                timeout=30)
+    req = m.registry.lookup("serve_request_rows")
+    batch = m.registry.lookup("serve_batch_rows")
+    assert req is not None and batch is not None
+    assert sorted(int(v) for _, v in req.series_state()[0]) == [1, 4, 9]
+    assert req.count == 3
+    assert batch.count == m.batches
+
+
+# -- code-review regression pins --------------------------------------
+
+def test_predict_latched_ladder_survives_concurrent_retire():
+    """predict latches ONE ladder snapshot for the whole call: a rung
+    retired mid-dispatch must keep serving through its cached program
+    (retire_rung's documented guarantee), never raise on a batch the
+    latched ladder covers."""
+    engine = _engine(buckets=(1, 8, 64))
+    engine.warmup()
+    cc = engine.compile_count
+    weights = engine._resolve(None)
+    ladder = engine.buckets  # the in-flight dispatch's snapshot
+    engine.retire_rung(64)
+    timings = {"pad_s": 0.0, "dispatch_s": 0.0}
+    out = engine._run(np.zeros((40, 16), np.float32), weights, timings,
+                      ladder)
+    assert out.shape == (40, 3)
+    assert timings["bucket"] == 64  # the retired rung, still compiled
+    assert engine.compile_count == cc
+
+
+def test_apply_proposal_rounds_rungs_on_mesh_engines():
+    """Mesh engines round rungs to device multiples: a proposed rung
+    that rounds onto an existing one installs (and charges) nothing,
+    and a current rung that is a proposed rung's rounded image is
+    never retired — the proposal's coverage survives the rounding."""
+    from fedamw_tpu.parallel import make_serving_mesh
+    from fedamw_tpu.serving.ladder import LadderProposal
+
+    rng = np.random.RandomState(6)
+    engine = ServingEngine({"w": rng.randn(3, 16).astype(np.float32)},
+                           buckets=(1, 8, 64),
+                           mesh=make_serving_mesh())
+    assert engine.buckets == (8, 64)  # rung 1 rounded up to 8 shards
+    engine.warmup()
+    prop = LadderProposal(
+        rungs=(5, 30, 64), install=(5, 30), retire=(8,),
+        sample_count=100, observed_max=64, waste_fraction=0.1,
+        baseline_waste_fraction=0.5, recompiles_charged=2)
+    m = _metrics_with_traffic([1])
+    learner = LadderLearner(m.registry, recompile_budget=4,
+                            min_samples=1)
+    ladder = apply_proposal(engine, prop, learner)
+    # 5 rounds onto the existing rung 8 (skipped, uncharged); 30
+    # rounds to a NEW rung 32 (installed, charged once); 8 is rung
+    # 5's rounded image, so the retire is skipped
+    assert ladder == (8, 32, 64)
+    assert learner.recompiles_spent == 1
+
+
+def test_rung_aware_carry_never_dispatches_past_the_top_rung():
+    """A rung-cut tail stacking with a holdover can make the carried
+    seed exceed the rung budget; the worker must trim the batch back
+    to it so the engine never chunks a coalesced service batch (which
+    would split a request across dispatches)."""
+    dispatched: list = []
+
+    class _Recorder(ServingEngine):
+        def predict(self, X, version=None, record_timings=True):
+            dispatched.append(int(np.atleast_2d(X).shape[0]))
+            return super().predict(X, version=version,
+                                   record_timings=record_timings)
+
+    engine = _Recorder({"w": np.random.RandomState(8).randn(
+        3, 16).astype(np.float32)}, buckets=(1, 8))
+    engine.warmup()
+    dispatched.clear()
+    rng = np.random.RandomState(9)
+    svc = ServingService(engine, mode="continuous", rung_aware=True)
+    svc._thread = object()  # queue a burst before the worker starts
+    futs = [svc.submit(rng.randn(5, 16).astype(np.float32))
+            for _ in range(4)]
+    svc._thread = None
+    with svc:
+        for f in futs:
+            assert f.result(timeout=30).shape == (5, 3)
+    # every service-level dispatch stayed within the top rung (the
+    # engine's own chunking path was never entered)
+    assert dispatched and max(dispatched) <= 8
+
+
+def test_install_rung_refuses_aot_on_jit_engine():
+    """A jit engine dispatches through its own cache — a supplied
+    executable would be silently discarded while the caller pays the
+    compile it exported to avoid; refused loudly instead."""
+    engine = _engine(buckets=(1, 8))
+    with pytest.raises(ValueError, match="artifact-loaded"):
+        engine.install_rung(4, aot=lambda x, p, r: x)
+    assert engine.buckets == (1, 8)  # nothing installed
+
+
+def test_record_batch_rejects_misaligned_slo_classes():
+    m = ServeMetrics()
+    with pytest.raises(ValueError, match="align"):
+        m.record_batch(n_requests=3, n_rows=3,
+                       latencies=[1e-3, 2e-3, 3e-3],
+                       slo_classes=["interactive"])

@@ -845,3 +845,47 @@ def test_ramp_batched_report_closes_multiple_windows():
         ctl.observe(cand, served=5)                  # residual + 5
         assert ctl.split()[1] == pytest.approx(0.8)
         ctl.rollback("test done")
+
+
+# -- off-thread shadow probe (ISSUE 13 satellite) ---------------------
+
+class _ThreadRecordingEngine(ServingEngine):
+    """Records which thread ran every CANDIDATE-version dispatch."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.candidate_threads: list = []
+
+    def predict(self, X, version=None, record_timings=True):
+        if version is not None:
+            self.candidate_threads.append(
+                threading.current_thread().name)
+        return super().predict(X, version=version,
+                               record_timings=record_timings)
+
+
+def test_shadow_probe_runs_off_the_worker_thread():
+    """The PR 7 carried follow-on: shadow warm dispatch must ride the
+    dedicated probe thread, never the serving worker (where it would
+    serialize candidate dispatch behind live traffic) — and every
+    accepted probe is still processed before stop() returns, so the
+    post-stop snapshot carries the full shadow count."""
+    engine = _ThreadRecordingEngine(base_params(), buckets=(1, 8, 32))
+    engine.warmup()
+    rng = np.random.RandomState(17)
+    reg = ModelRegistry()
+    cand = reg.publish(base_params(2.0), round_idx=1)
+    payload = rng.randn(2, D).astype(np.float32)
+    with ServingService(engine, max_wait_ms=0.5) as svc:
+        ctl = RolloutController(svc, reg, mode="shadow", fraction=1.0,
+                                min_requests=10 ** 6)  # never promotes
+        assert ctl.stage(cand) is True
+        for f in [svc.submit(payload) for _ in range(12)]:
+            f.result(timeout=30)
+    snap = svc.metrics.snapshot(engine)
+    # every probe landed (stop drains the probe queue before joining)
+    assert snap["shadow_requests"] == 12
+    assert snap["shadow_probes_dropped"] == 0
+    assert engine.candidate_threads  # probes actually dispatched
+    assert set(engine.candidate_threads) == {"serve-shadow-probe"}
+    ctl.rollback("test done")

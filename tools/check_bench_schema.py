@@ -46,7 +46,13 @@ Three artifact families, three rule sets:
   green), the exactly-once-span and zero-recompile pins re-checked,
   an SLO evaluation with at least one class, and a
   ``device_attribution`` record that either carries the profiler
-  split fields or names WHY it has none (the CPU fallback).
+  split fields or names WHY it has none (the CPU fallback). From
+  schema v6 on, the ``continuous_batching`` section (the ISSUE 13
+  learned-ladder leg) is required too: both paired legs (fixed-drain
+  baseline vs continuous over the learned ladder) present with
+  positive tails, the p95 improvement recorded, a non-empty learned
+  rung list, and the abort-grade pins re-checked —
+  ``recompiles_after_freeze == 0`` and exactly-once spans.
 - ``MULTICHIP_rNN.json`` — the dryrun wrapper: ``n_devices``/``rc``/
   ``ok``/``tail``, with ``ok`` true iff ``rc == 0`` (a disagreeing
   pair is exactly the silent-green failure this tool exists to catch).
@@ -176,6 +182,7 @@ def check_serve_artifact(art: dict, name: str) -> list[str]:
     errs.extend(_check_chaos_section(art, schema))
     errs.extend(_check_cold_start_section(art, schema))
     errs.extend(_check_telemetry_section(art, schema))
+    errs.extend(_check_continuous_section(art, schema))
     return errs
 
 
@@ -407,6 +414,66 @@ def _check_telemetry_section(art: dict, schema: str) -> list[str]:
         errs.append("telemetry_overhead: a non-profiler "
                     "device_attribution must carry its 'reason' (the "
                     "honest CPU-fallback shape)")
+    return errs
+
+
+def _check_continuous_section(art: dict, schema: str) -> list[str]:
+    """The v6+ ``continuous_batching`` contract (the ISSUE 13
+    learned-ladder continuous-batching leg): BOTH paired legs must be
+    present and measured (fixed-drain baseline vs continuous over the
+    learned ladder, each with a positive p95 on a positive request
+    count), the p95 improvement must be recorded, the learned ladder
+    must be a non-empty rung list, and the abort-grade pins are
+    re-checked at the gate: zero recompiles after ladder freeze and
+    exactly-once spans (a hand-edited artifact must not land green).
+    Earlier schema versions predate the leg and are grandfathered."""
+    if not schema.startswith("BENCH_SERVE."):
+        return []  # family error already reported by the caller
+    version = _schema_version(schema)
+    if version is None:
+        return []  # the rollout check already reported it
+    if version < 6:
+        return []
+    cb = art.get("continuous_batching")
+    if not isinstance(cb, dict):
+        return ["schema v6+ requires a 'continuous_batching' section "
+                "(the learned-ladder continuous-batching leg)"]
+    errs = []
+    for leg in ("baseline", "continuous"):
+        rec = cb.get(leg)
+        if not isinstance(rec, dict):
+            errs.append(f"continuous_batching: missing paired "
+                        f"{leg!r} leg record")
+            continue
+        if not isinstance(rec.get("requests"), int) \
+                or rec["requests"] < 1:
+            errs.append(f"continuous_batching: {leg} leg must record "
+                        "a positive request count")
+        for key in ("p50_ms", "p95_ms"):
+            if not isinstance(rec.get(key), (int, float)) \
+                    or rec[key] <= 0:
+                errs.append(f"continuous_batching: {leg} leg missing "
+                            f"positive numeric {key!r}")
+    imp = cb.get("p95_improvement_x")
+    if not isinstance(imp, (int, float)) or imp <= 0:
+        errs.append("continuous_batching: 'p95_improvement_x' must be "
+                    "a positive number (the paired comparison is the "
+                    "leg's whole claim)")
+    ladder = cb.get("ladder")
+    if not isinstance(ladder, dict) \
+            or not isinstance(ladder.get("learned"), list) \
+            or not ladder["learned"]:
+        errs.append("continuous_batching: 'ladder.learned' must be a "
+                    "non-empty rung list")
+    if cb.get("recompiles_after_freeze") != 0:
+        errs.append("continuous_batching: recompiles_after_freeze="
+                    f"{cb.get('recompiles_after_freeze')!r} — "
+                    "re-bucketing must never compile on the hot path "
+                    "after the learner froze")
+    if cb.get("spans_exactly_once") is not True:
+        errs.append("continuous_batching: 'spans_exactly_once' must "
+                    "be true (every accepted request id lands one "
+                    "span under continuous admission)")
     return errs
 
 

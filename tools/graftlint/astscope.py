@@ -42,11 +42,24 @@ import os
 #: Serving hot paths: module-relative posix path -> dotted qualnames.
 #: The GL002/GL003 scope — extend when a new dispatch surface lands.
 HOT_PATHS = {
+    "serving/batcher.py": {
+        # the ISSUE 13 continuous-admission loop: it runs once per
+        # dispatch on the worker thread, so a host sync or a
+        # shape-keyed cache here is a per-batch tax
+        "admit", "drain", "rung_cut"},
     "serving/engine.py": {
         "ServingEngine._run", "ServingEngine.predict"},
+    "serving/ladder.py": {
+        # the learner's read path: polled against live traffic by a
+        # re-bucketing controller — a shape-keyed cache here is the
+        # exact recompile-hazard pattern the learned ladder exists to
+        # avoid (install_rung/_warm_shape are deliberately NOT hot:
+        # their compile is the budgeted, off-thread cost)
+        "LadderLearner.observed_sizes", "LadderLearner.propose"},
     "serving/service.py": {
         "ServingService._worker", "ServingService._serve_batch",
-        "ServingService._serve_group", "ServingService._shadow_probe"},
+        "ServingService._serve_group", "ServingService._shadow_probe",
+        "ServingService._probe_worker"},
     "serving/replica.py": {
         "Replica.predict", "FailoverRouter.predict",
         "FailoverRouter._dispatch", "FailoverRouter._attempt",
