@@ -9,7 +9,10 @@ fixed-micro-batch drain), ``ladder`` (rung sets learned from the
 telemetry registry's observed request-size series under explicit
 pad-waste and recompile budgets), ``service`` (stdlib thread+queue
 request loop with deadlines, overload
-shedding, and rollout-aware traffic splitting), ``metrics`` (latency
+shedding, deadline-ordered dispatch under pressure, and rollout-aware
+traffic splitting), ``control`` (the ISSUE 14 overload control plane:
+burn-rate class-aware admission control and a hysteresis autoscaler
+consuming the PR 12 SLO signals), ``metrics`` (latency
 percentiles / throughput / shed counters / model-version + staleness
 dimensions), ``registry`` (versioned model store closing the
 train->serve loop, plus a checkpoint-watching publisher thread),
@@ -27,9 +30,12 @@ in the ``bench.py`` schema family with the same strict-backend guard.
 
 from .artifacts import (ArtifactIncompatible, ArtifactManifest,
                         export_ladder, load_ladder, prune_artifacts)
-from .batcher import (MicroBatcher, admit, coalesce, drain, partition,
-                      rung_cut, split_results)
-from .chaos import ChaosFault, ChaosPlan, ChaosSpec, resolve_chaos_plan
+from .batcher import (MicroBatcher, admit, coalesce, drain, edf_order,
+                      partition, rung_cut, split_results)
+from .chaos import (ChaosFault, ChaosPlan, ChaosSpec, LoadSpec,
+                    resolve_chaos_plan)
+from .control import (DEFAULT_SHED_ORDER, AdmissionController,
+                      AdmissionShed, Autoscaler, admission_shed_rate)
 from .engine import DEFAULT_BUCKETS, ServingEngine, bucket_for, infer_model
 from .ladder import (LadderLearner, LadderProposal, apply_proposal,
                      ladder_waste, learn_ladder)
@@ -42,16 +48,21 @@ from .service import (DeadlineExceeded, Overloaded, ServiceStopped,
                       ServingService)
 
 __all__ = [
+    "AdmissionController",
+    "AdmissionShed",
     "ArtifactIncompatible",
     "ArtifactManifest",
+    "Autoscaler",
     "ChaosFault",
     "ChaosPlan",
     "ChaosSpec",
     "CheckpointWatcher",
     "DEFAULT_BUCKETS",
+    "DEFAULT_SHED_ORDER",
     "DeadlineExceeded",
     "FailoverRouter",
     "LadderLearner",
+    "LoadSpec",
     "LadderProposal",
     "LatencyHistogram",
     "MicroBatcher",
@@ -68,12 +79,14 @@ __all__ = [
     "ServiceStopped",
     "ServingEngine",
     "ServingService",
+    "admission_shed_rate",
     "admit",
     "apply_proposal",
     "assigned_to_candidate",
     "bucket_for",
     "coalesce",
     "drain",
+    "edf_order",
     "export_ladder",
     "infer_model",
     "ladder_waste",

@@ -167,6 +167,31 @@ def rung_cut(rows_list, rungs) -> int:
     return cut if cut >= 1 else len(rows_list)
 
 
+def edf_order(batch) -> list:
+    """Deadline scheduling for an over-full admitted batch (ISSUE 14):
+    soonest-deadline-first, submit-time FIFO among equals, requests
+    with NO deadline last (infinitely patient by definition).
+
+    Applied by the continuous worker only UNDER PRESSURE — when the
+    admitted batch cannot fit one dispatch, so somebody must wait a
+    cycle — because that is the only time order matters: the deferred
+    tail is chosen from the latest deadlines instead of whoever
+    arrived last. The sort is stable and keys on ``(deadline,
+    t_submit)``, so an all-deadline-free batch comes back in exactly
+    its FIFO/carry order (the clean-load path is byte-identical), and
+    a deadline'd request can never be starved by later-deadline
+    traffic — its absolute deadline eventually sorts first.
+    """
+    inf = float("inf")
+
+    def key(r):
+        d = getattr(r, "deadline", None)
+        return (d if d is not None else inf,
+                getattr(r, "t_submit", 0.0))
+
+    return sorted(batch, key=key)
+
+
 def partition(requests, predicate) -> tuple[list, list]:
     """One-pass split of a micro-batch into ``(matching, rest)``,
     order preserved on both sides — how the service carves the rollout

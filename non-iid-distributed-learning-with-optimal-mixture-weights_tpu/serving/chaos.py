@@ -264,6 +264,142 @@ class ChaosPlan:
         return out
 
 
+#: Offered-load curve shapes the :class:`LoadSpec` grammar names.
+LOAD_SHAPES = ("diurnal", "flash", "overload")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadSpec:
+    """Seeded offered-load shape for the serving plane — the LOAD twin
+    of :class:`ChaosSpec` (ISSUE 14): chaos scripts how replicas fail,
+    a load spec scripts how traffic arrives, under the same
+    determinism contract (same spec ⇒ bitwise-identical arrival
+    schedule, so the overload bench and the control-plane tests replay
+    the exact same flash crowd every run).
+
+    Shapes (``rate(t)`` in requests/second over ``[0, duration_s)``):
+
+    - **diurnal**: one smooth day-cycle, ``base`` at the edges rising
+      to ``peak`` mid-window (``base + (peak-base) * (1-cos)/2``).
+    - **flash**: ``base`` everywhere except a step flash crowd at
+      ``peak`` over ``[at, at+width)`` (fractions of the duration) —
+      the scale-up-or-melt scenario the autoscaler exists for.
+    - **overload**: ramp from ``base`` to ``peak`` by ``at`` and HOLD
+      — sustained overload, the class-aware-shedding scenario (no
+      fleet size saves you; something must shed, least-critical
+      first).
+
+    Spec string syntax (mirrors the ``ChaosSpec`` grammar)::
+
+        shape=flash,base=200,peak=1600,duration=6,at=0.35,width=0.25,seed=17
+    """
+
+    shape: str = "flash"
+    base_rps: float = 100.0
+    peak_rps: float = 1000.0
+    duration_s: float = 10.0
+    at: float = 0.4      # flash start / overload ramp end (fraction)
+    width: float = 0.2   # flash length (fraction of the duration)
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.shape not in LOAD_SHAPES:
+            raise ValueError(f"load shape must be one of {LOAD_SHAPES}, "
+                             f"got {self.shape!r}")
+        if not (np.isfinite(self.base_rps) and self.base_rps > 0):
+            raise ValueError(f"base_rps={self.base_rps} must be a "
+                             "positive rate")
+        if not (np.isfinite(self.peak_rps)
+                and self.peak_rps >= self.base_rps):
+            raise ValueError(f"peak_rps={self.peak_rps} must be >= "
+                             f"base_rps={self.base_rps}")
+        if not (np.isfinite(self.duration_s) and self.duration_s > 0):
+            raise ValueError(f"duration_s={self.duration_s} must be "
+                             "positive")
+        if not 0.0 <= self.at <= 1.0:
+            raise ValueError(f"at={self.at} must be a fraction of the "
+                             "duration in [0, 1]")
+        if self.shape == "flash" and not (
+                0.0 < self.width and self.at + self.width <= 1.0):
+            raise ValueError(
+                f"flash window at={self.at} width={self.width} must "
+                "satisfy 0 < width and at + width <= 1")
+
+    @classmethod
+    def parse(cls, text: str) -> "LoadSpec":
+        """Parse the spec syntax (class docstring). Unknown keys and
+        malformed values raise ``ValueError`` naming the token — the
+        ``ChaosSpec.parse`` contract on the load axis."""
+        kw: dict = {}
+        keys = {"shape": str, "base": float, "peak": float,
+                "duration": float, "at": float, "width": float,
+                "seed": int}
+        field = {"base": "base_rps", "peak": "peak_rps",
+                 "duration": "duration_s"}
+        for token in text.split(","):
+            token = token.strip()
+            if not token:
+                continue
+            if "=" not in token:
+                raise ValueError(
+                    f"load spec token {token!r} is not key=value "
+                    "(expected e.g. 'shape=flash,base=200,peak=1600,"
+                    "duration=6,seed=17')")
+            key, val = token.split("=", 1)
+            key = key.strip().lower()
+            conv = keys.get(key)
+            if conv is None:
+                raise ValueError(
+                    f"unknown load spec key {key!r} (expected "
+                    f"{'/'.join(keys)})")
+            try:
+                kw[field.get(key, key)] = conv(val)
+            except ValueError as e:
+                raise ValueError(
+                    f"load spec token {token!r}: {e}") from None
+        return cls(**kw)
+
+    def rate(self, t: float) -> float:
+        """Offered load (requests/s) at ``t`` seconds into the window;
+        0 outside it."""
+        d = self.duration_s
+        if t < 0 or t >= d:
+            return 0.0
+        if self.shape == "diurnal":
+            return self.base_rps + (self.peak_rps - self.base_rps) \
+                * 0.5 * (1.0 - np.cos(2.0 * np.pi * t / d))
+        if self.shape == "flash":
+            lo = self.at * d
+            hi = lo + self.width * d  # lo + width*d, not (at+width)*d:
+            # the factored form keeps round fractions exact in float
+            return self.peak_rps if lo <= t < hi else self.base_rps
+        ramp_end = self.at * d
+        if t < ramp_end:
+            return self.base_rps + (self.peak_rps - self.base_rps) \
+                * (t / ramp_end)
+        return self.peak_rps
+
+    def offsets(self) -> np.ndarray:
+        """Seeded arrival offsets (seconds from stream start, sorted):
+        a non-homogeneous Poisson draw of the rate curve by standard
+        thinning — candidates at the peak rate, each kept with
+        probability ``rate(t)/peak``. Deterministic in the spec: the
+        same seed always yields the identical schedule (the pin
+        ``tests/test_control.py`` holds), so paired fleet runs replay
+        ONE flash crowd, not statistically-similar ones."""
+        rs = np.random.RandomState(self.seed)
+        out = []
+        t = 0.0
+        peak = self.peak_rps
+        while True:
+            t += rs.exponential(1.0 / peak)
+            if t >= self.duration_s:
+                break
+            if rs.random_sample() * peak <= self.rate(t):
+                out.append(t)
+        return np.asarray(out, dtype=np.float64)
+
+
 def resolve_chaos_plan(chaos, n_replicas: int,
                        horizon: int = 4096) -> ChaosPlan | None:
     """Normalize the ``chaos=`` argument the replica set accepts: None

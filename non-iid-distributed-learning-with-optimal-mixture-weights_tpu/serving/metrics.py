@@ -45,6 +45,30 @@ from ..utils.telemetry import Registry, SloEvaluator
 #: powers of two spanning the single-row to max-default-rung range.
 ROWS_BOUNDS = tuple(float(2 ** k) for k in range(13))
 
+#: Queue-stage residency as a registry TIME SERIES (seconds): the
+#: windowed queue-percentile family the admission controller and
+#: autoscaler corroborate the burn-rate trigger against (ISSUE 14) —
+#: the snapshot's ``queue_p50_ms`` family is exact but all-time, and a
+#: control loop needs the recent tail.
+QUEUE_RESIDENCY_METRIC = "serve_queue_residency_seconds"
+
+#: Per-class door-shed counter family (``{class=...}``): requests
+#: refused BEFORE queueing — policy sheds by the admission controller
+#: and ``Overloaded`` rejections at ``max_queue`` alike. What
+#: dashboards read to tell door shedding from deadline blowouts, and
+#: what the autoscaler reads as its capacity-shortfall signal (a
+#: class being refused IS unserved demand, whichever door refused it).
+SHED_CLASS_METRIC = "serve_requests_shed_total"
+
+#: Per-class deadline-miss counter family (``{class=...}``): requests
+#: whose deadline expired UNSERVED. ``SloEvaluator`` folds the
+#: window's misses into attainment as SLO-bad — a miss is bad
+#: regardless of how long it waited (judging it by waited time would
+#: read a 50ms death as "good" under a 100ms threshold and hide
+#: overload from the burn signal precisely when callers run deadlines
+#: tighter than the class objective).
+DEADLINE_MISS_METRIC = "serve_deadline_misses_total"
+
 
 class LatencyHistogram:
     """Exact-percentile latency recorder with reservoir degradation."""
@@ -165,7 +189,13 @@ class ServeMetrics:
             reason: reg.counter("serve_shed_total",
                                 "requests shed, by reason",
                                 labels={"reason": reason})
-            for reason in ("deadline", "overload", "shutdown")}
+            for reason in ("deadline", "overload", "shutdown",
+                           "admission")}
+        # per-class policy sheds + deadline misses (the ISSUE 14
+        # satellite): children cached so the submit/worker paths skip
+        # the registry creation lock
+        self._shed_class: dict = {}
+        self._miss_class: dict = {}
         self._c_retries = reg.counter(
             "serve_engine_retries_total",
             "transient engine-dispatch retries")
@@ -197,6 +227,14 @@ class ServeMetrics:
         self._h_batch_rows = reg.histogram(
             "serve_batch_rows", "rows per dispatched micro-batch",
             bounds=ROWS_BOUNDS)
+        # queue-stage residency as a windowed series (ISSUE 14): the
+        # admission/autoscaling corroboration family — stage_latency
+        # above keeps the exact all-time percentiles the snapshot
+        # contract reads; a control loop reads the recent tail here
+        self._h_queue_res = reg.histogram(
+            QUEUE_RESIDENCY_METRIC,
+            "queue-stage residency per request (control-plane "
+            "corroboration window)")
         self._g_queue_depth = reg.gauge(
             "serve_queue_depth", "observed queue depth at submit")
         self._g_staleness = reg.gauge(
@@ -246,6 +284,10 @@ class ServeMetrics:
     @property
     def shed_shutdown(self) -> int:
         return int(self._c_shed["shutdown"].value)
+
+    @property
+    def shed_admission(self) -> int:
+        return int(self._c_shed["admission"].value)
 
     @property
     def retries(self) -> int:
@@ -306,13 +348,67 @@ class ServeMetrics:
             if depth > self._queue_depth_peak:
                 self._queue_depth_peak = depth
 
-    def record_shed(self, reason: str) -> None:
+    def record_shed(self, reason: str,
+                    slo_class: str | None = None) -> None:
         """``reason``: 'deadline' (request expired while queued),
-        'overload' (rejected at the door), or 'shutdown' (backlog
-        dropped by a non-draining stop) — separable signals: an
-        operator alerting on deadline violations must not page on a
-        deliberate shutdown."""
+        'overload' (rejected at the door), 'admission' (policy-shed by
+        the admission controller), or 'shutdown' (backlog dropped by a
+        non-draining stop) — separable signals: an operator alerting
+        on deadline violations must not page on a deliberate shutdown.
+
+        ``slo_class``: the shed request's class. Deadline sheds count
+        on the per-class ``serve_deadline_misses_total`` family, which
+        ``SloEvaluator`` folds into attainment as SLO-bad; 'overload'
+        (``max_queue``) rejections count on the per-class door-shed
+        family the autoscaler reads — either way, without the class
+        dimension overload would be invisible to the control signals
+        exactly when it matters (survivorship bias: only the requests
+        that still got served would report latency). Misses are a
+        COUNTER, not a waited-time latency sample: a miss is bad
+        whatever it waited, while a waited-time sample under the class
+        threshold would read as good whenever a caller's deadline is
+        tighter than the SLO."""
         self._c_shed.get(reason, self._c_shed["overload"]).inc()
+        if slo_class is None:
+            return
+        if reason == "deadline":
+            c = self._miss_class.get(slo_class)
+            if c is None:
+                c = self.registry.counter(
+                    DEADLINE_MISS_METRIC,
+                    "requests whose deadline expired unserved, "
+                    "by class",
+                    labels={"class": slo_class})
+                self._miss_class[slo_class] = c
+            c.inc()
+        elif reason == "overload":
+            # a max_queue rejection is a door shed like an admission
+            # shed: same per-class family, so burn/shed-rate consumers
+            # see refused interactive traffic instead of a healthy
+            # survivor population
+            self._shed_counter(slo_class).inc()
+
+    def _shed_counter(self, slo_class: str):
+        c = self._shed_class.get(slo_class)
+        if c is None:
+            c = self.registry.counter(
+                SHED_CLASS_METRIC,
+                "requests shed at the door (admission policy or "
+                "max_queue overload), by class",
+                labels={"class": slo_class})
+            self._shed_class[slo_class] = c
+        return c
+
+    def record_admission_shed(self, slo_class: str) -> None:
+        """One request policy-shed at the door by admission control
+        (ISSUE 14): counted per CLASS on the ``serve_requests_shed_
+        total{class=...}`` family (the dashboard/autoscaler signal)
+        and under the generic shed reason 'admission'. Deliberately
+        NOT recorded into the latency family or the miss counter —
+        the controller's own shedding must not feed back into its
+        burn trigger (it would lock the shed level in forever)."""
+        self._shed_counter(slo_class).inc()
+        self._c_shed["admission"].inc()
 
     def record_swap(self, version, staleness_rounds: int = 0) -> None:
         """One hot weight swap: ``version`` is now live,
@@ -445,7 +541,13 @@ class ServeMetrics:
                 if isinstance(val, (list, tuple)):
                     hist.record_many(val)
                 else:
-                    hist.record_many([val] * int(n_requests))
+                    val = [val] * int(n_requests)
+                    hist.record_many(val)
+                if stage == "queue":
+                    # the control plane's corroboration window (one
+                    # bulk observe per batch, same budget discipline
+                    # as the families above)
+                    self._h_queue_res.observe_many(val)
 
     # -- SLO / export surfaces ----------------------------------------
     def slo(self, classes=None, windows_s=(60.0, 300.0)) -> dict:
@@ -486,6 +588,15 @@ class ServeMetrics:
             "shed_deadline": self.shed_deadline,
             "shed_overload": self.shed_overload,
             "shed_shutdown": self.shed_shutdown,
+            "shed_admission": self.shed_admission,
+            # dict() first: submit threads insert first-seen classes
+            # concurrently, and sorted() over a live dict could die
+            # mid-iteration (the registry makes re-creation idempotent,
+            # so the unlocked get-then-set in record_admission_shed is
+            # safe; this read just needs a stable view)
+            "requests_shed_by_class": {
+                cls: int(c.value)
+                for cls, c in sorted(dict(self._shed_class).items())},
             "retries": self.retries,
             "requests_retried": self.requests_retried,
             "max_request_retries": max_retries,

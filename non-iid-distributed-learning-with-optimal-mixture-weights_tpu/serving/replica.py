@@ -105,10 +105,34 @@ class Replica:
     never recompiles.
     """
 
-    def __init__(self, replica_id: int, engine, plan=None):
+    def __init__(self, replica_id: int, engine, plan=None,
+                 service_rate_rows_s: float | None = None):
+        """``service_rate_rows_s``: an optional per-replica CAPACITY
+        model (the load twin of the chaos plan's ``slow`` cells, used
+        by the overload bench and the control-plane tests): each
+        dispatch reserves ``rows / rate`` seconds of this replica's
+        serial capacity and waits until the replica is free before
+        running — so a fleet of N such replicas serves at most
+        ``N * rate`` rows/s and saturates REALISTICALLY (queue
+        residency grows, deadlines blow, burn rate climbs) instead of
+        at whatever one shared in-process engine happens to do. The
+        wait is for the replica to come FREE, not for the modeled
+        service time itself — the issuing worker stays pipelined, the
+        way a dispatch queue to a real remote host would. None (the
+        default) disables the model entirely: dispatch is
+        bit-identical to a bare engine call."""
         self.replica_id = int(replica_id)
         self.engine = engine
         self._plan = plan
+        # None disables; anything else must validate — a falsy 0 must
+        # hit the error below, not silently mean "infinitely fast"
+        self._rate = (None if service_rate_rows_s is None
+                      else float(service_rate_rows_s))
+        if self._rate is not None and self._rate <= 0:
+            raise ValueError(
+                f"service_rate_rows_s={service_rate_rows_s} must be a "
+                "positive rows/s capacity")
+        self._next_free = 0.0
         self._lock = threading.Lock()
         self._dispatches = 0
         self.dead = False
@@ -154,6 +178,18 @@ class Replica:
         if role == FLAKY:
             raise ChaosFault(
                 f"replica {self.replica_id} flaky dispatch {k}")
+        if self._rate is not None:
+            # the capacity model: reserve this batch's service time on
+            # the replica's serial timeline, wait until the replica is
+            # free (sleep OUTSIDE the lock — the reservation is the
+            # critical section, the waiting is not)
+            rows = 1 if X.ndim == 1 else int(X.shape[0])
+            with self._lock:
+                now = time.perf_counter()
+                start = self._next_free if self._next_free > now else now
+                self._next_free = start + rows / self._rate
+            if start > now:
+                time.sleep(start - now)
         t0 = time.perf_counter()
         out = self.engine.predict(X, version=version,
                                   record_timings=record_timings)
@@ -177,14 +213,16 @@ class ReplicaSet:
     """
 
     def __init__(self, engine, n_replicas: int, chaos=None,
-                 horizon: int = 4096):
+                 horizon: int = 4096,
+                 service_rate_rows_s: float | None = None):
         n_replicas = int(n_replicas)
         if n_replicas < 1:
             raise ValueError(
                 f"need at least one replica, got {n_replicas}")
         self.engine = engine
         self.plan = resolve_chaos_plan(chaos, n_replicas, horizon)
-        self.replicas = [Replica(i, engine, self.plan)
+        self.replicas = [Replica(i, engine, self.plan,
+                                 service_rate_rows_s=service_rate_rows_s)
                          for i in range(n_replicas)]
 
     def __len__(self) -> int:
@@ -300,14 +338,30 @@ class FailoverRouter:
                  ewma_alpha: float = 0.2, hedge: bool = False,
                  hedge_percentile: int = 95, hedge_factor: float = 2.0,
                  hedge_floor_ms: float = 1.0,
-                 hedge_min_samples: int = 20, registry=None):
+                 hedge_min_samples: int = 20, registry=None,
+                 hedge_window_s: float | None = None):
         """``registry`` (``utils.telemetry.Registry``, optional): when
         given, every successful dispatch additionally lands in the
         ``serve_replica_dispatch_seconds{replica=N}`` histogram family
         — the per-replica latency TIME SERIES the EWMA cannot provide
-        (an EWMA has no window percentiles), and the signal an
-        adaptive hedge threshold / autoscaler (ROADMAP direction 4)
-        reads. None keeps the router registry-free."""
+        (an EWMA has no window percentiles) — and in the fleet-level
+        ``serve_fleet_dispatch_seconds`` series the adaptive hedge
+        threshold reads. None keeps the router registry-free.
+
+        ``hedge_window_s`` (ISSUE 14, the ROADMAP carried item):
+        ADAPTIVE hedging — the hedge threshold becomes the
+        ``hedge_percentile`` of the dispatch latencies observed in
+        the trailing ``hedge_window_s`` seconds (the registry's
+        rolling series) times ``hedge_factor``, instead of the same
+        percentile of the all-time reservoir. A fleet whose latency
+        regime SHIFTS (a slow replica joins, load rises, a chaos
+        phase starts) re-arms its threshold within one window,
+        where the all-time percentile would keep hedging against a
+        distribution that no longer exists. Requires ``registry``
+        (the window lives in its series); until the window holds
+        ``hedge_min_samples`` dispatches the threshold falls back to
+        the all-time reservoir — a cold window must not disarm
+        tail protection that evidence already supports."""
         self.replicas = list(replicas)
         if not self.replicas:
             raise ValueError("FailoverRouter needs at least one replica")
@@ -333,6 +387,25 @@ class FailoverRouter:
         self.hedge_factor = float(hedge_factor)
         self.hedge_floor_ms = float(hedge_floor_ms)
         self.hedge_min_samples = int(hedge_min_samples)
+        self.hedge_window_s = (None if hedge_window_s is None
+                               else float(hedge_window_s))
+        if self.hedge_window_s is not None:
+            if self.hedge_window_s <= 0:
+                raise ValueError(
+                    f"hedge_window_s={hedge_window_s} must be positive")
+            if registry is None:
+                raise ValueError(
+                    "adaptive hedging (hedge_window_s) needs a "
+                    "registry= — the rolling window lives in its "
+                    "series")
+        # health-plane construction params kept: replicas added at
+        # runtime (Autoscaler scale-out) get identical circuit/EWMA
+        # settings to the founding fleet
+        self._failure_threshold = int(failure_threshold)
+        self._cooldown_s = float(cooldown_s)
+        self._ewma_alpha = float(ewma_alpha)
+        self._registry = registry
+        self._removed = 0
         self._lock = threading.RLock()
         self._health = {r.replica_id: ReplicaHealth(
             failure_threshold, cooldown_s, ewma_alpha)
@@ -355,6 +428,14 @@ class FailoverRouter:
                 "successful dispatch latency, by replica",
                 labels={"replica": r.replica_id})
             for r in self.replicas}
+        # fleet-level dispatch series: the adaptive hedge threshold's
+        # rolling evidence (a per-replica family cannot answer "what
+        # does a NORMAL dispatch cost right now" in one read)
+        self._fleet_hist = None if registry is None else \
+            registry.histogram(
+                "serve_fleet_dispatch_seconds",
+                "successful dispatch latency, fleet-wide (adaptive "
+                "hedge window)")
         self._pool: ThreadPoolExecutor | None = None
         self._timings: dict | None = None
 
@@ -429,6 +510,70 @@ class FailoverRouter:
     def __exit__(self, *exc):
         self.close()
 
+    # -- elastic fleet (ISSUE 14) -------------------------------------
+    def fleet_size(self) -> int:
+        with self._lock:
+            return len(self.replicas)
+
+    def add_replica(self, replica: Replica) -> int:
+        """Grow the fleet at runtime — the Autoscaler's scale-out
+        hook. The replica must share THE engine (the single-host
+        contract ``__init__`` enforces: one compiled ladder, one
+        weight store — which is also why attaching is microseconds:
+        there is nothing to compile or load, the engine came up once,
+        ideally from a PR 9 artifact). It gets a fresh circuit/EWMA
+        with the founding fleet's settings and is routable from the
+        next ``_pick``. Returns the replica id."""
+        if replica.engine is not self.engine:
+            raise ValueError(
+                "added replica must share the fleet's ONE engine "
+                "(one compiled bucket ladder / weight store)")
+        rid = replica.replica_id
+        reg_hist = None
+        if self._registry is not None:
+            # built OUTSIDE the router lock, same as __init__: the
+            # registry's creation lock must not nest under routing
+            reg_hist = self._registry.histogram(
+                "serve_replica_dispatch_seconds",
+                "successful dispatch latency, by replica",
+                labels={"replica": rid})
+        with self._lock:
+            if any(r.replica_id == rid for r in self.replicas):
+                raise ValueError(
+                    f"replica id {rid} is already in the fleet")
+            self.replicas.append(replica)
+            self._health[rid] = ReplicaHealth(
+                self._failure_threshold, self._cooldown_s,
+                self._ewma_alpha)
+            # counters survive a remove/re-add cycle (cumulative — an
+            # id that served twice reports everything it ever did)
+            self._counts.setdefault(rid, {"routed": 0, "ok": 0,
+                                          "failed": 0, "requeued": 0,
+                                          "cancelled": 0})
+            if reg_hist is not None:
+                self._reg_hist[rid] = reg_hist
+        return rid
+
+    def remove_replica(self, replica_id: int) -> None:
+        """Retire a replica from ROUTING — the Autoscaler's scale-in
+        hook. Its health and counter entries stay (an in-flight
+        dispatch racing the removal still lands its accounting; the
+        entries are a few ints), it just never gets picked again.
+        Refuses to empty the fleet: scale-to-zero is a shutdown, not
+        a routing decision."""
+        with self._lock:
+            idx = next((i for i, r in enumerate(self.replicas)
+                        if r.replica_id == replica_id), None)
+            if idx is None:
+                raise KeyError(
+                    f"replica {replica_id} is not in the fleet")
+            if len(self.replicas) == 1:
+                raise ValueError(
+                    "refusing to remove the last replica — an empty "
+                    "fleet serves nothing; stop the service instead")
+            self.replicas.pop(idx)
+            self._removed += 1
+
     # -- health / routing ---------------------------------------------
     def _pick(self, excluded: set) -> Replica | None:
         now = time.perf_counter()
@@ -469,10 +614,15 @@ class FailoverRouter:
 
     def _raise_unroutable(self, excluded: set):
         with self._lock:
-            dead = sum(1 for h in self._health.values() if h.dead)
-        if dead == len(self.replicas):
+            # count over the CURRENT fleet, not the health dict: a
+            # removed replica's retained health entry must not make a
+            # live fleet read as all-dead
+            n = len(self.replicas)
+            dead = sum(1 for r in self.replicas
+                       if self._health[r.replica_id].dead)
+        if dead == n:
             raise NoReplicasAvailable(
-                f"all {len(self.replicas)} replicas are dead; nothing "
+                f"all {n} replicas are dead; nothing "
                 "left to fail over to")
         raise ReplicaUnavailable(
             "no routable replica this pass (every survivor is "
@@ -500,7 +650,9 @@ class FailoverRouter:
                     "hedges": self.hedges,
                     "hedge_wins": self.hedge_wins,
                     "hedges_cancelled": self.hedges_cancelled,
-                    "dead_replicas": dead}
+                    "dead_replicas": dead,
+                    "fleet_size": len(self.replicas),
+                    "removed_replicas": self._removed}
 
     # -- dispatch -----------------------------------------------------
     def _attempt(self, rep: Replica, X, version, record_timings,
@@ -589,18 +741,36 @@ class FailoverRouter:
             # per-replica latency series (outside the router lock —
             # the instrument locks itself)
             reg_hist.observe(dt)
+        if self._fleet_hist is not None:
+            # the adaptive hedge window's evidence — cancelled
+            # dispatches never reach here, so a drafted mirror's race
+            # cannot distort the threshold either
+            self._fleet_hist.observe(dt)
         return out, timing
 
     def _hedge_timeout_s(self) -> float | None:
         """The latency-percentile hedge threshold, in seconds — None
         until hedging is enabled AND enough dispatches were observed
         to make the percentile meaningful (hedging off a cold
-        histogram would mirror everything)."""
+        histogram would mirror everything). With ``hedge_window_s``
+        set (adaptive mode), the percentile tracks the LIVE latency
+        distribution — dispatches in the trailing window — and falls
+        back to the all-time reservoir while the window is thin."""
         if not self.hedge:
             return None
+        q = self.hedge_percentile
+        if self.hedge_window_s is not None \
+                and self._fleet_hist is not None:
+            vals = self._fleet_hist.window_values(self.hedge_window_s)
+            if len(vals) >= self.hedge_min_samples:
+                vals.sort()
+                idx = min(len(vals) - 1,
+                          max(0, -(-q * len(vals) // 100) - 1))
+                return max(self.hedge_floor_ms / 1e3,
+                           vals[idx] * self.hedge_factor)
+            # thin window: fall through to the all-time evidence
         if self._hist.count < self.hedge_min_samples:
             return None
-        q = self.hedge_percentile
         p = self._hist.percentiles((q,))[f"p{q}_ms"]
         if p is None:
             return None

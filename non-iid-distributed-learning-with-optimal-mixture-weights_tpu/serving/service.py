@@ -50,8 +50,9 @@ from concurrent.futures import Future, InvalidStateError
 import numpy as np
 
 from ..utils.trace import NULL_TRACER
-from .batcher import (admit, coalesce, drain, partition, request_rows,
-                      rung_cut, split_results)
+from .batcher import (admit, coalesce, drain, edf_order, partition,
+                      request_rows, rung_cut, split_results)
+from .control import AdmissionShed
 from .metrics import ServeMetrics
 from .rollout import assigned_to_candidate
 
@@ -118,6 +119,7 @@ class _Request:
     id: str = ""  # request id assigned at submit; rides the whole path
     retries: int = 0  # transient engine-dispatch retries this request saw
     slo: str = "default"  # SLO class label on the latency family
+    deferrals: int = 0  # EDF cycles this request was deferred (aging)
 
 
 class ServingService:
@@ -135,11 +137,20 @@ class ServingService:
     #: baseline of the serve bench's continuous_batching leg).
     MODES = ("continuous", "drain")
 
+    #: EDF aging bound: a request deferred this many scheduling cycles
+    #: is exempted to the FRONT of the next batch regardless of its
+    #: deadline. Pure EDF would starve deadline-FREE requests under a
+    #: sustained deadline'd stream (they sort last forever, and fresh
+    #: arrivals leapfrog them every cycle) — aging restores the
+    #: pre-EDF bounded-holdover guarantee: every request dispatches
+    #: within EDF_MAX_DEFERRALS + 1 cycles of first being admitted.
+    EDF_MAX_DEFERRALS = 4
+
     def __init__(self, engine, max_queue: int = 1024,
                  max_wait_ms: float = 2.0, metrics: ServeMetrics | None = None,
                  retries: int = 2, retry_backoff_ms: float = 5.0,
                  tracer=None, router=None, mode: str = "continuous",
-                 rung_aware: bool = False):
+                 rung_aware: bool = False, admission=None):
         """``mode``: batch-formation policy (:data:`MODES`). In
         ``"continuous"`` (default) ``max_wait_ms`` is unused — the
         batching window is the previous dispatch itself; ``"drain"``
@@ -177,12 +188,25 @@ class ServingService:
         the candidate version — dispatched-and-discarded in shadow
         mode, answered-from-candidate (with live fallback on failure)
         in ab mode — reporting outcomes back via ``router.observe``.
-        None serves everything from the engine's live version."""
+        None serves everything from the engine's live version.
+
+        ``admission`` (``serving.control.AdmissionController``, ISSUE
+        14): class-aware policy shedding at the door. When set, every
+        submit first asks ``admission.admit(slo_class)``; a refused
+        request never queues — its Future resolves with the typed
+        :class:`~serving.control.AdmissionShed` (NOT raised like
+        ``Overloaded``: the request was well-formed and accepted far
+        enough to earn a request id, a ``shed``-annotated span, and
+        the per-class ``serve_requests_shed_total`` counter — the
+        surfaces a dashboard needs to tell policy shedding from
+        deadline blowouts). None admits everything, the pre-ISSUE-14
+        behavior."""
         if mode not in self.MODES:
             raise ValueError(f"mode must be one of {self.MODES}, "
                              f"got {mode!r}")
         self.engine = engine
         self.router = router
+        self.admission = admission
         self.mode = mode
         self.rung_aware = bool(rung_aware)
         self.tracer = tracer if tracer is not None else NULL_TRACER
@@ -289,6 +313,13 @@ class ServingService:
         if outcome == "deadline":
             self.tracer.annotate("deadline_exceeded", req.id,
                                  where=where or "queued")
+        elif outcome == "shed":
+            # the ISSUE 14 satellite: policy shedding is attributable
+            # on the trace, distinct from the deadline annotation — a
+            # dashboard joining spans can split "we refused it" from
+            # "we were too slow for it"
+            self.tracer.annotate("shed", req.id, slo_class=req.slo,
+                                 policy="admission")
         self.tracer.emit("request", req.id, req.t_submit,
                          done - req.t_submit, attrs=attrs)
 
@@ -384,7 +415,7 @@ class ServingService:
                 # the sweep honors deadlines exactly like the worker's
                 # dequeue check — a stop() race must not turn an
                 # already-expired request into a late success
-                self.metrics.record_shed("deadline")
+                self.metrics.record_shed("deadline", slo_class=req.slo)
                 self._trace_request(req, "deadline", t_seen,
                                     queue_s=t_seen - req.t_submit,
                                     where="sweep")
@@ -439,9 +470,12 @@ class ServingService:
         ``slo_class`` labels the request on the metrics plane's
         per-class latency family (``serve_request_latency_seconds
         {class=...}``) — the SLO attainment/burn-rate input
-        (``ServeMetrics.slo()``). Purely observational today: class-
-        aware shedding and deadline scheduling are ROADMAP direction 4,
-        and they will read exactly this dimension."""
+        (``ServeMetrics.slo()``) — and, since ISSUE 14, DRIVES the
+        control plane: with an ``admission`` controller attached the
+        class decides whether this request is policy-shed (the
+        returned Future then resolves with ``AdmissionShed``), and
+        the class's typical deadline shapes the worker's EDF dispatch
+        order under pressure."""
         if self._thread is None:
             raise RuntimeError("service not started")
         x = np.asarray(x, dtype=np.float32)
@@ -466,6 +500,21 @@ class ServingService:
         # the id is caller-visible: a client logging fut.request_id can
         # join its own records against the exported trace
         fut.request_id = req.id
+        if self.admission is not None \
+                and not self.admission.admit(req.slo):
+            # policy shed BEFORE the queue (ISSUE 14): the controller
+            # decided this class sheds under the current burn rate,
+            # so the request must not spend queue residency only to
+            # blow a deadline later. Resolved, not raised — the typed
+            # AdmissionShed rides the Future like every other outcome,
+            # with its span and per-class counter (see __init__)
+            self.metrics.record_admission_shed(req.slo)
+            self._trace_request(req, "shed", time.perf_counter())
+            _resolve(fut, exc=AdmissionShed(
+                f"{req.slo!r} request shed by admission control "
+                "(error-budget burn over threshold; lower classes "
+                "shed first) — back off or degrade"))
+            return fut
         with self._depth_lock:
             # stop-check and enqueue are ATOMIC under the lock: stop()
             # flips the flag under the same lock, so a put either
@@ -487,7 +536,10 @@ class ServingService:
                 # graftlint: disable=GL004 the queue is UNBOUNDED (depth is bounded here, by _depth) so put never blocks; stop-check+enqueue must stay one atomic region
                 self._q.put(req)
         if shed:
-            self.metrics.record_shed("overload")
+            # class-attributed: a refused interactive request must
+            # reach the shed-rate signal, or the control plane reads
+            # a door-rejecting service as healthy survivors
+            self.metrics.record_shed("overload", slo_class=req.slo)
             raise Overloaded(
                 f"queue depth {depth} at capacity "
                 f"(max_queue={self.max_queue})")
@@ -503,10 +555,13 @@ class ServingService:
         carry: list = []  # requests dequeued but not yet dispatched:
         # the over-budget holdover plus (continuous mode) the
         # rung-cut's deferred tail. Carried requests seed the NEXT
-        # batch ahead of fresh arrivals, so a deferred request's extra
-        # delay is bounded to one dispatch — it can never starve
-        # behind a sustained stream (they advance strictly frontward
-        # each cycle, and every dispatch serves at least one of them)
+        # batch ahead of fresh arrivals; under pressure the EDF sort
+        # may then push a later-deadline carried request behind
+        # sooner-deadline fresh traffic, so the pre-EDF "strictly
+        # frontward" bound no longer holds per cycle — the aging
+        # exemption (EDF_MAX_DEFERRALS) restores a hard bound: every
+        # request dispatches within EDF_MAX_DEFERRALS + 1 cycles of
+        # first being admitted, deadline or not
         while True:
             if not carry:
                 try:
@@ -532,7 +587,37 @@ class ServingService:
                 # and the serve bench measured the cut net-negative,
                 # so it is opt-in, for backends where pad rows cost
                 # real device time)
-                batch, held = admit(self._q, carry, max_rows)
+                # admission budget is TWO rungs, not one: the extra
+                # rung is the EDF lookahead window — at exactly one
+                # rung, a batch that fills to the brim would hide the
+                # soonest-deadline request sitting just behind it in
+                # the queue, and "deadline scheduling" would degrade
+                # to FIFO precisely under the pressure it exists for.
+                # The overflow seeds the next batch via the carry (the
+                # same bounded holdover contract as before; depth
+                # accounting is per DISPATCHED request, unchanged).
+                batch, held = admit(self._q, carry, 2 * max_rows)
+                rows_list = [request_rows(r.x) for r in batch]
+                if held is not None or sum(rows_list) > max_rows:
+                    # PRESSURE: more admitted than one dispatch can
+                    # take, so somebody defers — deadline scheduling
+                    # (ISSUE 14): soonest-deadline-first, so the
+                    # deferred tail is the most-patient traffic, not
+                    # whoever arrived last. Stable FIFO among equal /
+                    # absent deadlines, so the clean-load path is
+                    # byte-identical to the pre-EDF worker. AGED
+                    # requests (deferred EDF_MAX_DEFERRALS times) jump
+                    # the sort entirely: EDF alone would starve a
+                    # deadline-free request behind a sustained
+                    # deadline'd stream forever.
+                    batch = edf_order(batch)
+                    aged = [r for r in batch
+                            if r.deferrals >= self.EDF_MAX_DEFERRALS]
+                    if aged:
+                        batch = aged + [
+                            r for r in batch
+                            if r.deferrals < self.EDF_MAX_DEFERRALS]
+                    rows_list = [request_rows(r.x) for r in batch]
                 # hard-cap the batch at the rung budget: a carried
                 # seed can EXCEED it when a rung-cut tail stacks with
                 # a holdover, and dispatching past the top rung would
@@ -541,7 +626,6 @@ class ServingService:
                 # holdover contract forbids. The head request always
                 # dispatches (oversized singles are the engine's
                 # documented chunking case).
-                rows_list = [request_rows(r.x) for r in batch]
                 cap, rows = 1, rows_list[0]
                 while cap < len(batch) and \
                         rows + rows_list[cap] <= max_rows:
@@ -559,6 +643,11 @@ class ServingService:
                 carry = []
             if held is not None:
                 carry.append(held)
+            for r in carry:
+                # the EDF aging clock: one tick per cycle a request
+                # sits deferred (no-op in drain mode — its carry is
+                # only ever the single holdover, served next cycle)
+                r.deferrals += 1
             with self._depth_lock:
                 # these requests left the queue for good (the holdover
                 # stays accounted until its own batch serves it)
@@ -567,7 +656,11 @@ class ServingService:
             live = []
             for req in batch:
                 if req.deadline is not None and now > req.deadline:
-                    self.metrics.record_shed("deadline")
+                    # the class rides onto the deadline-miss counter
+                    # the SLO evaluator folds in as SLO-bad: under
+                    # overload the shed requests ARE the signal
+                    self.metrics.record_shed("deadline",
+                                             slo_class=req.slo)
                     self._trace_request(req, "deadline", now,
                                         queue_s=now - req.t_submit,
                                         where="queued")
@@ -831,7 +924,8 @@ class ServingService:
                            if r.deadline is not None and now > r.deadline]
                 if expired:
                     for req in expired:
-                        self.metrics.record_shed("deadline")
+                        self.metrics.record_shed("deadline",
+                                                 slo_class=req.slo)
                         self._trace_request(
                             req, "deadline", now,
                             queue_s=t_formed - req.t_submit,

@@ -294,6 +294,33 @@ def format_failover_report(chaos: dict) -> str:
     return " ".join(str(b) for b in bits)
 
 
+def format_overload_report(ov: dict) -> str:
+    """One human-readable line for the elastic-serving overload leg
+    (the ``overload`` section ``serve_bench.py`` emits — the
+    autoscaled fleet's SLO-good-per-replica-second against every
+    fixed fleet, interactive protection, shed and scale counters):
+    the control-plane mirror of :func:`format_failover_report`."""
+    fleets = ov.get("fleets", {})
+    auto = fleets.get("autoscaled", {})
+    fixed = {name: rec.get("good_per_replica_s")
+             for name, rec in sorted(fleets.items())
+             if name != "autoscaled"}
+    bits = [
+        "overload:",
+        f"autoscaled {auto.get('good_per_replica_s')} good/replica-s "
+        f"vs fixed {fixed}",
+        f"(beats all: {ov.get('autoscaled_beats_every_fixed')})",
+        f"interactive attainment "
+        f"{auto.get('attainment', {}).get('interactive')}",
+        f"batch shed {ov.get('batch_shed', 0)}",
+        f"scale-ups {ov.get('scale_ups', 0)} "
+        f"(peak {auto.get('replicas_peak')})",
+        f"lost {ov.get('lost_accepted', 0)}",
+        f"recompiles {ov.get('recompiles_during_overload', 0)}",
+    ]
+    return " ".join(str(b) for b in bits)
+
+
 def load_results(path: str) -> dict:
     """Load an ``exp1_{dataset}.pkl`` result dict (driver schema)."""
     with open(path, "rb") as f:

@@ -529,7 +529,12 @@ class SloEvaluator:
     """Per-class SLO attainment + error-budget burn rate over rolling
     windows, read from a latency histogram family in ``registry``
     (label ``class=<name>``, values in SECONDS — the family
-    ``ServeMetrics`` records).
+    ``ServeMetrics`` records) plus the per-class deadline-miss counter
+    family (``miss_metric``): a request whose deadline expired
+    UNSERVED is SLO-bad regardless of how long it waited — judging it
+    by its waited time would read a 50ms death as "good" under a
+    100ms threshold, hiding overload from the burn signal exactly
+    when callers run deadlines tighter than the class objective.
 
     ``evaluate()`` is a pure read (no instrument mutation): safe to
     poll from any thread at any cadence — the admission-control /
@@ -539,15 +544,51 @@ class SloEvaluator:
     def __init__(self, registry: Registry,
                  metric: str = "serve_request_latency_seconds",
                  classes=DEFAULT_SLO_CLASSES,
-                 windows_s=(60.0, 300.0)):
+                 windows_s=(60.0, 300.0),
+                 miss_metric: str = "serve_deadline_misses_total"):
         if not classes:
             raise ValueError("need at least one SloClass")
         if not windows_s or any(w <= 0 for w in windows_s):
             raise ValueError(f"windows must be positive, got {windows_s}")
         self.registry = registry
         self.metric = metric
+        self.miss_metric = miss_metric
         self.classes = tuple(classes)
         self.windows_s = tuple(float(w) for w in windows_s)
+
+    def _window_record(self, cls: SloClass, window_s: float,
+                       now: float) -> dict:
+        """ONE class x window evaluation — the single definition both
+        :meth:`evaluate` and :meth:`burn_rates` share (two copies of
+        this arithmetic would let the admission controller and the
+        SLO export disagree about the same window). ``total`` counts
+        served requests PLUS deadline misses; only served
+        under-threshold requests are ``good``."""
+        hist = self.registry.lookup(self.metric,
+                                    labels={"class": cls.name})
+        vals = (hist.window_values(window_s, now=now)
+                if isinstance(hist, Histogram) else [])
+        miss = self.registry.lookup(self.miss_metric,
+                                    labels={"class": cls.name})
+        missed = (int(round(miss.rate(window_s, now=now) * window_s))
+                  if isinstance(miss, Counter) else 0)
+        total = len(vals) + missed
+        thr_s = cls.threshold_ms / 1e3
+        good = sum(1 for v in vals if v <= thr_s)
+        budget = 1.0 - cls.objective
+        if total:
+            att = good / total
+            err = 1.0 - att
+            burn = err / budget
+        else:
+            att = err = burn = None
+        return {
+            "total": total, "good": good, "missed": missed,
+            "attainment": None if att is None else round(att, 6),
+            "error_rate": None if err is None else round(err, 6),
+            "budget": round(budget, 6),
+            "burn_rate": None if burn is None else round(burn, 4),
+        }
 
     def evaluate(self, now: float | None = None) -> dict:
         """``{"schema": "SLO.v1", "classes": {name: {objective,
@@ -561,36 +602,34 @@ class SloEvaluator:
         out: dict = {"schema": "SLO.v1", "now_s": round(now, 6),
                      "metric": self.metric, "classes": {}}
         for cls in self.classes:
-            # non-creating lookup: evaluating a class that has seen no
-            # traffic must not register a phantom empty family into
-            # every subsequent export (evaluate() is a pure read)
-            hist = self.registry.lookup(self.metric,
-                                        labels={"class": cls.name})
+            # non-creating lookups throughout (_window_record):
+            # evaluating a class that has seen no traffic must not
+            # register a phantom empty family into every subsequent
+            # export (evaluate() is a pure read)
             rec: dict = {"objective": cls.objective,
                          "threshold_ms": cls.threshold_ms,
                          "windows": {}}
-            thr_s = cls.threshold_ms / 1e3
-            budget = 1.0 - cls.objective
             for w in self.windows_s:
-                vals = (hist.window_values(w, now=now)
-                        if isinstance(hist, Histogram) else [])
-                total = len(vals)
-                good = sum(1 for v in vals if v <= thr_s)
-                if total:
-                    att = good / total
-                    err = 1.0 - att
-                    burn = err / budget
-                else:
-                    att = err = burn = None
-                rec["windows"][f"{int(w)}s"] = {
-                    "total": total, "good": good,
-                    "attainment": None if att is None else round(att, 6),
-                    "error_rate": None if err is None else round(err, 6),
-                    "budget": round(budget, 6),
-                    "burn_rate": None if burn is None else round(burn, 4),
-                }
+                rec["windows"][f"{int(w)}s"] = \
+                    self._window_record(cls, w, now)
             out["classes"][cls.name] = rec
         return out
+
+    def burn_rates(self, window_s: float | None = None,
+                   now: float | None = None) -> dict:
+        """One window's records only — ``{class_name: window_record}``
+        with the same fields ``evaluate`` emits (``total`` / ``good`` /
+        ``attainment`` / ``burn_rate`` ...), over ``window_s`` (default:
+        the evaluator's first configured window). The admission
+        controller and autoscaler poll exactly one window per tick;
+        computing every configured window there would be wasted work
+        on the submit path."""
+        w = self.windows_s[0] if window_s is None else float(window_s)
+        if w <= 0:
+            raise ValueError(f"window must be positive, got {window_s}")
+        now = self.registry.clock() if now is None else float(now)
+        return {cls.name: self._window_record(cls, w, now)
+                for cls in self.classes}
 
 
 # ---------------------------------------------------------------------

@@ -105,6 +105,20 @@ abort-grade; the headline mixed stream is ALSO open-loop paced now
 (``SERVE_PACE_FACTOR`` x a closed-loop calibration), so its queue
 percentiles measure service under load rather than backlog drain.
 
+The ISSUE 14 overload leg (``overload``, schema BENCH_SERVE.v7)
+proves the overload CONTROL plane (``serving/control.py``): one
+seeded flash-crowd ``LoadSpec`` schedule driven through fixed-N
+fleets (1 / min / max replicas, no control) and through the
+admission-controlled autoscaled fleet (burn-rate class-aware
+shedding — shadow and batch first, interactive never; EDF dispatch
+under pressure; burn/shed-rate-driven scale-out with hysteresis),
+all over ONE AOT artifact-loaded engine so scale-out rides the PR 9
+plane and nothing ever compiles. Abort-grade: the autoscaled fleet
+beats every fixed fleet on SLO-good requests per replica-second,
+interactive attainment holds its objective while batch sheds, at
+least one scale-up fires, zero lost accepted requests, zero
+recompiles, exactly-once spans (shed requests included).
+
 Env knobs: SERVE_BUCKETS ("1,8,64,512"), SERVE_D (RFF width, 256),
 SERVE_N (train rows, 4096), SERVE_CLIENTS (8), SERVE_TRAIN_ROUNDS (2),
 SERVE_ITERS (per-bucket timed calls, 30), SERVE_REQUESTS (mixed-stream
@@ -126,7 +140,12 @@ policy), SERVE_CB_REPS (paired continuous-batching reps, best-of per
 mode, default 5), SERVE_CB_RUNGS (learned-ladder
 program budget, default 6), SERVE_CB_BUDGET (learner recompile
 budget, default 6), SERVE_DEVATTR_REPS (profiled dispatches in the
-device-attribution probe, default 6),
+device-attribution probe, default 6), SERVE_OVERLOAD_LOAD (the
+overload leg's LoadSpec string; default a seeded flash crowd),
+SERVE_OVERLOAD_REPLICA_ROWS_S (modeled per-replica capacity, 1500),
+SERVE_OVERLOAD_MIN_REPLICAS (2) / SERVE_OVERLOAD_MAX_REPLICAS (4),
+SERVE_OVERLOAD_INT_MS (interactive SLO threshold, 100) /
+SERVE_OVERLOAD_INT_OBJECTIVE (0.8),
 SERVE_OUT, SERVE_ROUND (artifact suffix, default 1),
 SERVE_TRACE (directory: export the traced leg's span records as JSONL
 there, and stream the rollout leg's spans there as rotating parts),
@@ -665,6 +684,39 @@ def chaos_bench(engine, n_requests, max_wait_ms):
     return section
 
 
+def export_artifact_checked(warm_engine, ckpt, buckets, art_dir):
+    """Export ``warm_engine``'s ladder as a PR 9 AOT artifact into
+    ``art_dir`` and return the manifest. With BENCH_COMPILE_CACHE
+    active this process may have loaded cross-process cache entries —
+    which corrupts XLA:CPU executable serialization (export_ladder
+    self-checks and refuses) — so the export runs the operator CLI in
+    a FRESH process instead; the cost then includes interpreter+jax
+    startup, which is exactly what an operator's export step costs
+    anyway. Shared by the cold-start and overload legs (both start
+    replicas from the artifact plane)."""
+    from fedamw_tpu.serving.artifacts import export_ladder
+
+    if os.environ.get("BENCH_COMPILE_CACHE"):
+        import subprocess
+
+        from fedamw_tpu.serving.artifacts import ArtifactManifest
+
+        env = dict(os.environ)
+        env.pop("BENCH_COMPILE_CACHE", None)
+        cli = os.path.join(os.path.dirname(os.path.abspath(
+            __file__)), "tools", "export_artifacts.py")
+        run = subprocess.run(
+            [sys.executable, cli, ckpt, art_dir, "--buckets",
+             ",".join(str(b) for b in buckets)],
+            env=env, capture_output=True, text=True, timeout=300)
+        if run.returncode != 0:
+            print(f"# serve_bench aborted: artifact export CLI "
+                  f"failed: {run.stderr[-1000:]}", file=sys.stderr)
+            raise SystemExit(1)
+        return ArtifactManifest.load(art_dir)
+    return export_ladder(warm_engine, art_dir)
+
+
 def cold_start_bench(ckpt, buckets, setup, X_test_raw):
     """The ISSUE 9 cold-start leg: the two ways a replica can come up,
     timed side by side from the SAME checkpoint. Compile-warmup start
@@ -681,7 +733,6 @@ def cold_start_bench(ckpt, buckets, setup, X_test_raw):
     section (BENCH_SERVE.v4). SERVE_ARTIFACT_DIR keeps the exported
     artifact; otherwise it is scratch."""
     from fedamw_tpu.serving import ServingEngine
-    from fedamw_tpu.serving.artifacts import export_ladder
 
     t0 = time.perf_counter()
     cold = ServingEngine.load(ckpt, buckets=buckets)
@@ -694,33 +745,7 @@ def cold_start_bench(ckpt, buckets, setup, X_test_raw):
         art_dir = scratch = tempfile.mkdtemp(prefix="serve_artifact_")
     try:
         t0 = time.perf_counter()
-        if os.environ.get("BENCH_COMPILE_CACHE"):
-            # with the persistent compile cache active, this process
-            # may have loaded cross-process cache entries — which
-            # corrupts XLA:CPU executable serialization (export_ladder
-            # self-checks and refuses). Export from a FRESH process
-            # via the operator CLI instead: the export cost then
-            # includes interpreter+jax startup, which is exactly what
-            # an operator's export step costs anyway.
-            import subprocess
-
-            from fedamw_tpu.serving.artifacts import ArtifactManifest
-
-            env = dict(os.environ)
-            env.pop("BENCH_COMPILE_CACHE", None)
-            cli = os.path.join(os.path.dirname(os.path.abspath(
-                __file__)), "tools", "export_artifacts.py")
-            run = subprocess.run(
-                [sys.executable, cli, ckpt, art_dir, "--buckets",
-                 ",".join(str(b) for b in buckets)],
-                env=env, capture_output=True, text=True, timeout=300)
-            if run.returncode != 0:
-                print(f"# serve_bench aborted: artifact export CLI "
-                      f"failed: {run.stderr[-1000:]}", file=sys.stderr)
-                raise SystemExit(1)
-            manifest = ArtifactManifest.load(art_dir)
-        else:
-            manifest = export_ladder(cold, art_dir)
+        manifest = export_artifact_checked(cold, ckpt, buckets, art_dir)
         export_s = time.perf_counter() - t0
 
         t0 = time.perf_counter()
@@ -865,6 +890,291 @@ def telemetry_bench(engine, n_requests, max_wait_ms):
               "(> the 1.05 committed-artifact bound; "
               "tools/check_bench_schema.py will refuse this artifact)",
               file=sys.stderr)
+    return section
+
+
+def overload_bench(ckpt, buckets, max_wait_ms):
+    """The ISSUE 14 elastic-serving leg (schema BENCH_SERVE.v7): the
+    overload CONTROL plane proven against the fleets it replaces. One
+    seeded flash-crowd load shape (``serving.chaos.LoadSpec`` — same
+    determinism contract as the chaos plan: every fleet replays the
+    IDENTICAL arrival schedule and class mix) is driven through four
+    fleets over ONE AOT artifact-loaded engine (scale-out rides the
+    PR 9 plane, so ``compile_count`` is zero before, during, and
+    after — attaching a replica is microseconds, measured per event):
+
+    - fixed-N fleets (N = 1, 2, max): no admission control, no
+      autoscaler — the pre-ISSUE-14 shape. Under the flash crowd the
+      small ones melt (interactive and batch blow deadlines
+      together); the big one coasts, burning ``N x wall``
+      replica-seconds all run.
+    - the AUTOSCALED fleet: ``AdmissionController`` (burn-rate
+      trigger, queue-residency corroboration, shadow-then-batch shed
+      order, interactive never policy-shed) + ``Autoscaler``
+      (burn/shed-rate driven scale-out with hysteresis and a
+      max-fleet bound) + deadline scheduling in the continuous worker
+      (EDF under pressure).
+
+    Per-replica capacity is modeled (``Replica(service_rate_rows_s=)``
+    — N replicas serve at most N x rate rows/s), so saturation is a
+    property of the SCHEDULE, not of whatever the host's one
+    in-process engine happens to do; the flash peak is sized ~2.5x a
+    single replica's capacity.
+
+    The headline is **SLO-good requests per replica-second** (classed
+    requests answered within their class threshold, over the fleet's
+    integrated size x time): the autoscaled fleet must beat EVERY
+    fixed fleet — small fleets lose on good requests, big ones on
+    replica-seconds. Abort-grade, like parity: the beat itself;
+    interactive attainment >= its objective while batch sheds
+    (``requests_shed{class=batch}`` > 0); at least one scale-up; zero
+    LOST accepted requests in every fleet (shed and deadline are
+    typed outcomes, anything else is a loss); zero recompiles; every
+    submitted request id — shed ones included — landing exactly one
+    span.
+
+    Env knobs: SERVE_OVERLOAD_LOAD (LoadSpec string),
+    SERVE_OVERLOAD_REPLICA_ROWS_S (per-replica modeled capacity),
+    SERVE_OVERLOAD_MIN/MAX_REPLICAS, SERVE_OVERLOAD_INT_MS /
+    SERVE_OVERLOAD_INT_OBJECTIVE (the interactive class's SLO).
+    """
+    from fedamw_tpu.serving import (AdmissionController, AdmissionShed,
+                                    Autoscaler, DeadlineExceeded,
+                                    FailoverRouter, LoadSpec, Overloaded,
+                                    Replica, ServeMetrics, ServingEngine,
+                                    ServingService)
+    from fedamw_tpu.utils.telemetry import Registry, SloClass
+    from fedamw_tpu.utils.trace import Tracer
+
+    spec = LoadSpec.parse(os.environ.get(
+        "SERVE_OVERLOAD_LOAD",
+        "shape=flash,base=150,peak=1100,duration=8,at=0.4,width=0.5,"
+        "seed=17"))
+    rate_rows = float(os.environ.get(
+        "SERVE_OVERLOAD_REPLICA_ROWS_S", "1500"))
+    n_min = _env_int("SERVE_OVERLOAD_MIN_REPLICAS", 2)
+    n_max = _env_int("SERVE_OVERLOAD_MAX_REPLICAS", 4)
+    int_ms = float(os.environ.get("SERVE_OVERLOAD_INT_MS", "100"))
+    int_obj = float(os.environ.get(
+        "SERVE_OVERLOAD_INT_OBJECTIVE", "0.8"))
+    classes = (SloClass("interactive", threshold_ms=int_ms,
+                        objective=int_obj),
+               SloClass("batch", threshold_ms=1000.0, objective=0.5))
+    thresholds = {c.name: c.threshold_ms / 1e3 for c in classes}
+    offsets = spec.offsets()
+    # deterministic class mix, cycled over the seeded arrivals:
+    # interactive is half the requests but a quarter of the ROWS —
+    # batch (8-row payloads) is the row mass the shed policy trades
+    # away to protect it; shadow is the first class to go
+    mix = [("interactive", 1, 0.5), ("batch", 8, 3.0),
+           ("interactive", 2, 0.5), ("shadow", 1, 1.0),
+           ("interactive", 1, 0.5), ("batch", 8, 3.0)]
+
+    # ONE artifact-loaded engine behind every fleet: scale-out is the
+    # PR 9 cold-start plane (nothing ever compiles), and the paired
+    # fleets measure policy, not engine variance
+    warm = ServingEngine.load(ckpt, buckets=buckets)
+    warm.warmup()
+    scratch = tempfile.mkdtemp(prefix="serve_overload_art_")
+    try:
+        t0 = time.perf_counter()
+        export_artifact_checked(warm, ckpt, buckets, scratch)
+        export_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        engine = ServingEngine.from_artifact(scratch, checkpoint=ckpt)
+        load_s = time.perf_counter() - t0
+        payloads = {
+            r: np.random.RandomState(41).randn(
+                r, engine.input_dim).astype(np.float32)
+            for r in sorted({rows for _, rows, _ in mix})}
+
+        def run_fleet(n0, autoscaled):
+            metrics = ServeMetrics(registry=Registry())
+            replicas = [Replica(i, engine, None,
+                                service_rate_rows_s=rate_rows)
+                        for i in range(n0)]
+            router = FailoverRouter(replicas, policy="round_robin",
+                                    registry=metrics.registry)
+            tracer = Tracer(max_spans=4 * len(offsets) + 64)
+            admission = autoscaler = None
+            if autoscaled:
+                admission = AdmissionController(
+                    metrics, classes=classes,
+                    shed_order=("shadow", "batch"), window_s=0.75,
+                    burn_threshold=1.0, min_window_requests=8,
+                    queue_floor_ms=int_ms / 2, interval_s=0.02,
+                    escalate_ticks=1, relax_ticks=15)
+                autoscaler = Autoscaler(
+                    router,
+                    replica_factory=lambda rid: Replica(
+                        rid, engine, None,
+                        service_rate_rows_s=rate_rows),
+                    metrics=metrics, classes=classes, window_s=0.75,
+                    min_replicas=n0, max_replicas=n_max,
+                    scale_up_burn=1.0, scale_down_burn=0.25,
+                    queue_floor_ms=int_ms / 2, up_ticks=1,
+                    down_ticks=12, cooldown_s=0.3,
+                    min_window_requests=8)
+            recs, futs, submitted = [], [], []
+            cc0 = engine.compile_count
+            gc.collect()
+            with ServingService(router, max_wait_ms=max_wait_ms,
+                                max_queue=max(4096, len(offsets)),
+                                tracer=tracer, metrics=metrics,
+                                admission=admission) as svc:
+                if autoscaler is not None:
+                    autoscaler.start(interval_s=0.05)
+                t0 = time.perf_counter()
+                for i, off in enumerate(offsets):
+                    lag = t0 + off - time.perf_counter()
+                    if lag > 0:
+                        # absolute offsets: submit overhead never
+                        # compresses the seeded schedule
+                        time.sleep(lag)
+                    cls, rows_n, timeout = mix[i % len(mix)]
+                    rec = {"cls": cls, "t0": time.perf_counter(),
+                           "outcome": None, "dt": None}
+
+                    def _done(f, rec=rec):
+                        rec["dt"] = time.perf_counter() - rec["t0"]
+                        e = f.exception()
+                        rec["outcome"] = (
+                            "ok" if e is None else
+                            "shed" if isinstance(e, AdmissionShed) else
+                            "deadline" if isinstance(e, DeadlineExceeded)
+                            else "lost")
+                    try:
+                        f = svc.submit(payloads[rows_n],
+                                       timeout_s=timeout,
+                                       slo_class=cls)
+                    except Overloaded:
+                        # max_queue admits the whole schedule; landing
+                        # here means the bound was mis-sized — a loss
+                        rec["outcome"] = "lost"
+                        recs.append(rec)
+                        continue
+                    submitted.append(f.request_id)
+                    f.add_done_callback(_done)
+                    recs.append(rec)
+                    futs.append(f)
+                for f in futs:
+                    try:
+                        f.result(timeout=120)
+                    except Exception:
+                        pass  # classified in the callback
+                wall = time.perf_counter() - t0
+                rs = (autoscaler.replica_seconds() if autoscaler
+                      else n0 * wall)
+                if autoscaler is not None:
+                    autoscaler.stop()
+                snap = metrics.snapshot(router)
+            counts = {"ok": 0, "shed": 0, "deadline": 0, "lost": 0}
+            per_cls: dict = {}
+            good = 0
+            for rec in recs:
+                counts[rec["outcome"] or "lost"] += 1
+                cls = rec["cls"]
+                c = per_cls.setdefault(cls, {"n": 0, "good": 0})
+                c["n"] += 1
+                thr = thresholds.get(cls)
+                if rec["outcome"] == "ok" and thr is not None \
+                        and rec["dt"] <= thr:
+                    c["good"] += 1
+                    good += 1
+            spans = [r for r in tracer.records()
+                     if r["name"] == "request"]
+            ids = [r["trace_id"] for r in spans]
+            section = {
+                "replicas_start": n0,
+                "replicas_peak": (
+                    max((e["size"] for e in autoscaler.events),
+                        default=n0) if autoscaler else n0),
+                "replica_seconds": round(rs, 3),
+                "wall_s": round(wall, 3),
+                "requests": len(recs),
+                **counts,
+                "good": good,
+                "good_per_replica_s": round(good / rs, 3),
+                "attainment": {
+                    cls: round(c["good"] / c["n"], 4)
+                    for cls, c in sorted(per_cls.items())},
+                "p95_ms": snap["p95_ms"],
+                "queue_p95_ms": snap["queue_p95_ms"],
+                "shed_by_class": snap["requests_shed_by_class"],
+                "recompiles": engine.compile_count - cc0,
+                "spans_exactly_once": (
+                    sorted(ids) == sorted(submitted)
+                    and tracer.dropped == 0),
+            }
+            if autoscaler is not None:
+                section.update(
+                    scale_ups=autoscaler.scale_ups,
+                    scale_downs=autoscaler.scale_downs,
+                    autoscaler_errors=autoscaler.errors,
+                    attach_ms=[e["attach_ms"]
+                               for e in autoscaler.events
+                               if e["action"] == "up"],
+                    events=autoscaler.events,
+                    admission_level_final=admission.level,
+                    admission_evaluations=admission.evaluations)
+            return section
+
+        fixed_sizes = sorted({1, n_min, n_max})
+        fleets = {f"fixed_{n}": run_fleet(n, autoscaled=False)
+                  for n in fixed_sizes}
+        fleets["autoscaled"] = run_fleet(n_min, autoscaled=True)
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+    auto = fleets["autoscaled"]
+    beats = {
+        name: auto["good_per_replica_s"] > rec["good_per_replica_s"]
+        for name, rec in fleets.items() if name != "autoscaled"}
+    int_ok = auto["attainment"].get("interactive", 0.0) >= int_obj
+    batch_shed = int(auto["shed_by_class"].get("batch", 0))
+    section = {
+        "load": {"shape": spec.shape, "base_rps": spec.base_rps,
+                 "peak_rps": spec.peak_rps,
+                 "duration_s": spec.duration_s, "seed": spec.seed,
+                 "requests": int(len(offsets))},
+        "classes": {c.name: {"threshold_ms": c.threshold_ms,
+                             "objective": c.objective}
+                    for c in classes},
+        "replica_rows_per_s": rate_rows,
+        "artifact_export_s": round(export_s, 3),
+        "artifact_load_s": round(load_s, 4),
+        "fleets": fleets,
+        "autoscaled_beats_every_fixed": all(beats.values()),
+        "beats": beats,
+        "interactive_attainment_ok": bool(int_ok),
+        "batch_shed": batch_shed,
+        "lost_accepted": sum(rec["lost"] for rec in fleets.values()),
+        "scale_ups": auto.get("scale_ups", 0),
+        "recompiles_during_overload": sum(
+            rec["recompiles"] for rec in fleets.values()),
+        "spans_exactly_once": all(
+            rec["spans_exactly_once"] for rec in fleets.values()),
+    }
+    if (not section["autoscaled_beats_every_fixed"] or not int_ok
+            or batch_shed < 1 or section["lost_accepted"]
+            or section["recompiles_during_overload"]
+            or not section["spans_exactly_once"]
+            or section["scale_ups"] < 1
+            or auto.get("autoscaler_errors", 0)):
+        # abort-grade, like parity: an elastic fleet that does not
+        # beat every fixed fleet on SLO-good work per replica-second,
+        # loses an accepted request, compiles anything, drops a span,
+        # fails to protect interactive, or never actually scaled must
+        # not emit green-looking numbers
+        slim = {k: v for k, v in section.items() if k != "fleets"}
+        slim["fleet_summary"] = {
+            name: {k: rec.get(k) for k in (
+                "good_per_replica_s", "replica_seconds", "good",
+                "requests", "lost", "attainment")}
+            for name, rec in fleets.items()}
+        print(f"# serve_bench aborted: overload leg failed "
+              f"({json.dumps(slim)})", file=sys.stderr)
+        raise SystemExit(1)
     return section
 
 
@@ -1284,6 +1594,7 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
     telemetry = telemetry_bench(engine, n_requests=n_requests,
                                 max_wait_ms=max_wait_ms)
     telemetry_s = time.perf_counter() - t_tel0
+    from fedamw_tpu.utils.reporting import format_overload_report
     print(f"# telemetry plane: {telemetry['overhead_x']}x vs plane-off "
           f"({telemetry['plane_on_req_per_s']} vs "
           f"{telemetry['plane_off_req_per_s']} req/s; "
@@ -1291,6 +1602,16 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
           f"{telemetry['registry_points']} series points; device "
           f"attribution: {telemetry['device_attribution']['source']})",
           file=sys.stderr)
+
+    # ISSUE 14: the overload leg — the burn-rate admission controller
+    # + autoscaled fleet against every fixed-N fleet under one seeded
+    # flash crowd; the beat, interactive protection, zero lost
+    # accepted requests, zero recompiles, and exactly-once spans are
+    # abort-grade
+    t_ov0 = time.perf_counter()
+    overload = overload_bench(ckpt, tuple(engine.buckets), max_wait_ms)
+    overload_s = time.perf_counter() - t_ov0
+    print(f"# {format_overload_report(overload)}", file=sys.stderr)
 
     # the zero-recompile pin now spans EVERY stream — untraced, traced,
     # and the rollout leg's swapped versions: tracing must not perturb
@@ -1332,13 +1653,13 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
 
     artifact = {
         "metric": "serve_bench",
-        # v6: the continuous_batching section (learned-ladder
-        # continuous batching) joins the v5 telemetry_overhead, v4
+        # v7: the overload section (elastic fleet + admission control)
+        # joins the v6 continuous_batching, v5 telemetry_overhead, v4
         # cold_start, v3 chaos, and v2 rollout sections in the
         # contract — tools/check_bench_schema.py requires each from
         # its version on (earlier artifacts are grandfathered by
         # schema version)
-        "schema": "BENCH_SERVE.v6",
+        "schema": "BENCH_SERVE.v7",
         "platform": platform,
         "engine": {
             "buckets": list(engine.buckets),
@@ -1357,6 +1678,7 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
                    "cold_start_s": round(cold_s, 3),
                    "telemetry_s": round(telemetry_s, 3),
                    "continuous_batching_s": round(cb_s, 3),
+                   "overload_s": round(overload_s, 3),
                    # None when BENCH_COMPILE_CACHE is unset (cold by
                    # construction); else dir + entry counts, so a
                    # warm-cache compile_warmup_s can never be read as
@@ -1370,6 +1692,7 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
         "cold_start": cold,
         "telemetry_overhead": telemetry,
         "continuous_batching": cb,
+        "overload": overload,
         "trace": {
             "request_spans": len(req_spans),
             "unique_request_ids": len(set(ids)),
@@ -1395,9 +1718,37 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
         json.dump(artifact, f, indent=1)
     print(f"# artifact -> {out_path}", file=sys.stderr)
 
-    # the continuous-batching line (FIRST of the leg lines — each new
-    # leg prepends, so every existing line position the contract test
-    # pins is unmoved and the headline stays LAST): the paired p95
+    # the overload line (FIRST of the leg lines — each new leg
+    # prepends, so every existing line position the contract test
+    # pins is unmoved and the headline stays LAST): the elastic
+    # fleet's whole claim — SLO-good work per replica-second vs the
+    # best fixed fleet, interactive protected while batch sheds,
+    # nothing lost, nothing compiled
+    best_fixed = max(
+        rec["good_per_replica_s"]
+        for name, rec in overload["fleets"].items()
+        if name != "autoscaled")
+    print(json.dumps({
+        "metric": "serve_overload",
+        "value": overload["fleets"]["autoscaled"]["good_per_replica_s"],
+        "unit": "slo-good-req-per-replica-second",
+        "best_fixed": best_fixed,
+        "beats_every_fixed": overload["autoscaled_beats_every_fixed"],
+        "interactive_attainment":
+            overload["fleets"]["autoscaled"]["attainment"].get(
+                "interactive"),
+        "batch_shed": overload["batch_shed"],
+        "scale_ups": overload["scale_ups"],
+        "replicas_peak": overload["fleets"]["autoscaled"]
+            ["replicas_peak"],
+        "lost_accepted": overload["lost_accepted"],
+        "recompiles_during_overload":
+            overload["recompiles_during_overload"],
+        "spans_exactly_once": overload["spans_exactly_once"],
+        "platform": platform,
+    }))
+
+    # the continuous-batching line: the paired p95
     # improvement over the fixed-drain baseline, the learned ladder,
     # and the zero-recompile-after-freeze pin
     print(json.dumps({

@@ -427,6 +427,122 @@ def test_serve_v6_rejects_continuous_batching_drift(tmp_path):
     assert any("spans_exactly_once" in e for e in cbs.validate_file(p))
 
 
+def _overload_fleet(good_per_rs, **extra):
+    rec = {"replicas_start": 2, "replicas_peak": 2,
+           "replica_seconds": 16.0, "wall_s": 8.0, "requests": 5000,
+           "ok": 4800, "shed": 0, "deadline": 200, "lost": 0,
+           "good": 3000, "good_per_replica_s": good_per_rs,
+           "attainment": {"interactive": 0.95, "batch": 0.8},
+           "p95_ms": 40.0, "queue_p95_ms": 30.0, "shed_by_class": {},
+           "recompiles": 0, "spans_exactly_once": True}
+    rec.update(extra)
+    return rec
+
+
+GOOD_OVERLOAD = {
+    "load": {"shape": "flash", "base_rps": 150.0, "peak_rps": 1100.0,
+             "duration_s": 8.0, "seed": 17, "requests": 5000},
+    "classes": {"interactive": {"threshold_ms": 100.0,
+                                "objective": 0.8},
+                "batch": {"threshold_ms": 1000.0, "objective": 0.5}},
+    "replica_rows_per_s": 1500.0,
+    "artifact_export_s": 0.2, "artifact_load_s": 0.02,
+    "fleets": {
+        "fixed_1": _overload_fleet(70.0, replicas_start=1,
+                                   replicas_peak=1,
+                                   replica_seconds=8.0),
+        "fixed_4": _overload_fleet(134.0, replicas_start=4,
+                                   replicas_peak=4,
+                                   replica_seconds=32.0),
+        "autoscaled": _overload_fleet(
+            170.0, replicas_peak=4, scale_ups=2, scale_downs=1,
+            shed_by_class={"batch": 400, "shadow": 100}),
+    },
+    "autoscaled_beats_every_fixed": True,
+    "beats": {"fixed_1": True, "fixed_4": True},
+    "interactive_attainment_ok": True,
+    "batch_shed": 400,
+    "lost_accepted": 0,
+    "scale_ups": 2,
+    "recompiles_during_overload": 0,
+    "spans_exactly_once": True,
+}
+
+
+def _serve_art_v7(**extra):
+    art = _serve_art_v6(schema="BENCH_SERVE.v7",
+                        overload=json.loads(
+                            json.dumps(GOOD_OVERLOAD)))
+    art.update(extra)
+    return art
+
+
+def test_serve_v7_requires_overload_section(tmp_path):
+    """From schema v7 on, the elastic-serving leg's 'overload'
+    section is contract; v6 artifacts predate it and stay valid."""
+    assert cbs.validate_file(
+        _write(tmp_path, "BENCH_SERVE_r09.json", _serve_art_v7())) == []
+    art = _serve_art_v7()
+    del art["overload"]
+    errs = cbs.validate_file(_write(tmp_path, "BENCH_SERVE_r09.json",
+                                    art))
+    assert any("'overload' section" in e for e in errs)
+    # v6 stays valid without the section (pre-ISSUE-14 shape)
+    v6 = _serve_art_v6()
+    assert cbs.validate_file(
+        _write(tmp_path, "BENCH_SERVE_r09.json", v6)) == []
+
+
+def test_serve_v7_rejects_overload_drift(tmp_path):
+    # the comparison must be present and measured for every fleet
+    ov = json.loads(json.dumps(GOOD_OVERLOAD))
+    del ov["fleets"]["autoscaled"]
+    p = _write(tmp_path, "BENCH_SERVE_r09.json",
+               _serve_art_v7(overload=ov))
+    assert any("autoscaled" in e for e in cbs.validate_file(p))
+    for key, bad, needle in (
+            ("requests", 0, "positive request count"),
+            ("replica_seconds", 0, "replica_seconds"),
+            ("good_per_replica_s", None, "good_per_replica_s"),
+            ("lost", 3, "lost")):
+        ov = json.loads(json.dumps(GOOD_OVERLOAD))
+        ov["fleets"]["fixed_1"][key] = bad
+        p = _write(tmp_path, "BENCH_SERVE_r09.json",
+                   _serve_art_v7(overload=ov))
+        assert any(needle in e for e in cbs.validate_file(p)), \
+            f"accepted broken overload fleet {key}={bad!r}"
+    # the abort-grade pins, re-checked at the gate — including the
+    # beat, NUMERICALLY: an artifact whose autoscaled fleet does not
+    # strictly exceed every fixed fleet must not land green even if
+    # its boolean says otherwise
+    ov = json.loads(json.dumps(GOOD_OVERLOAD))
+    ov["fleets"]["autoscaled"]["good_per_replica_s"] = 100.0
+    p = _write(tmp_path, "BENCH_SERVE_r09.json",
+               _serve_art_v7(overload=ov))
+    assert any("must beat" in e for e in cbs.validate_file(p))
+    for key, bad, needle in (
+            ("autoscaled_beats_every_fixed", False,
+             "autoscaled_beats_every_fixed"),
+            ("interactive_attainment_ok", False,
+             "interactive_attainment_ok"),
+            ("batch_shed", 0, "batch_shed"),
+            ("lost_accepted", 2, "lost_accepted"),
+            ("recompiles_during_overload", 1, "never compile"),
+            ("spans_exactly_once", False, "spans_exactly_once")):
+        ov = json.loads(json.dumps(GOOD_OVERLOAD))
+        ov[key] = bad
+        p = _write(tmp_path, "BENCH_SERVE_r09.json",
+                   _serve_art_v7(overload=ov))
+        assert any(needle in e for e in cbs.validate_file(p)), \
+            f"accepted broken overload {key}={bad!r}"
+    # an autoscaler that never scaled proves nothing
+    ov = json.loads(json.dumps(GOOD_OVERLOAD))
+    ov["fleets"]["autoscaled"]["scale_ups"] = 0
+    p = _write(tmp_path, "BENCH_SERVE_r09.json",
+               _serve_art_v7(overload=ov))
+    assert any("scale_ups" in e for e in cbs.validate_file(p))
+
+
 def test_rejects_multichip_ok_rc_disagreement(tmp_path):
     p = _write(tmp_path, "MULTICHIP_r09.json",
                {"n_devices": 8, "rc": 124, "ok": True, "tail": "OK"})

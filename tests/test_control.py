@@ -1,0 +1,828 @@
+"""serving/control.py — the overload control plane (ISSUE 14).
+
+Load-bearing contracts:
+
+- **Load-shape grammar determinism**: a ``LoadSpec`` expands to a
+  bitwise-identical arrival schedule for the same seed (the serving
+  twin of the chaos plan's pin) — the overload bench replays ONE
+  flash crowd across fleets, not statistically-similar ones.
+- **Hand-computed burn-rate fixtures**: known latency samples under an
+  injectable clock drive the admission controller's escalate/relax
+  machine and the autoscaler's up/down machine deterministically —
+  trigger = burn > threshold, queue-percentile corroboration gates
+  it, hysteresis (ticks / dead band / cooldown) prevents flapping.
+- **Class-aware shedding**: shadow sheds first, then batch;
+  interactive is NEVER policy-shed; rejections resolve futures with
+  the typed ``AdmissionShed`` (not the deadline path), counted per
+  class and annotated ``shed`` on the span.
+- **Elastic fleet**: ``FailoverRouter.add_replica/remove_replica``
+  grow/shrink routing at runtime; the autoscaler scales up under a
+  flash crowd, never past ``max_replicas``, scales down only after
+  sustained quiet and only replicas it added, and its
+  replica-seconds integral is hand-checkable.
+- **Deadline scheduling**: under pressure the continuous worker
+  dispatches soonest-deadline-first (``batcher.edf_order``); the
+  clean-load path is byte-identical FIFO.
+- **Interactive protection under sustained overload** (real time): a
+  throttled fleet at ~2x capacity with the controller attached keeps
+  interactive attainment above batch while batch sheds, and loses
+  nothing.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedamw_tpu.serving import (AdmissionController, AdmissionShed,
+                                Autoscaler, FailoverRouter, LoadSpec,
+                                Replica, ReplicaSet, ServeMetrics,
+                                ServingEngine, ServingService,
+                                admission_shed_rate, edf_order)
+from fedamw_tpu.serving.metrics import (QUEUE_RESIDENCY_METRIC,
+                                        SHED_CLASS_METRIC)
+from fedamw_tpu.utils.telemetry import Registry, SloClass, SloEvaluator
+from fedamw_tpu.utils.trace import Tracer
+
+pytestmark = pytest.mark.control
+
+D, C = 16, 3
+
+CLASSES = (SloClass("interactive", threshold_ms=50.0, objective=0.99),
+           SloClass("batch", threshold_ms=500.0, objective=0.95))
+
+
+def make_engine(buckets=(1, 8, 32)):
+    rng = np.random.RandomState(1)
+    e = ServingEngine({"w": rng.randn(C, D).astype(np.float32)},
+                      buckets=buckets)
+    e.warmup()
+    return e
+
+
+class Clock:
+    """Injectable monotonic clock: tests advance time by hand."""
+
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_plane(clk):
+    """A metrics bundle on a fake-clock registry — every series
+    timestamp below is hand-placed."""
+    return ServeMetrics(registry=Registry(clock=clk))
+
+
+def feed(m, n_bad, n_good, cls="batch", queue_s=0.4, bad_s=0.9,
+         good_s=0.005):
+    """Record ``n_bad`` over-threshold + ``n_good`` under-threshold
+    latencies for ``cls`` plus queue residency — one hand-computed
+    burn-rate evidence batch at the registry clock's current time."""
+    n = n_bad + n_good
+    m.record_batch(n, n, latencies=[bad_s] * n_bad + [good_s] * n_good,
+                   stage_seconds={"queue": [queue_s] * n},
+                   slo_classes=[cls] * n)
+
+
+# -- LoadSpec: grammar + determinism ----------------------------------
+
+def test_load_spec_parse_full_grammar():
+    s = LoadSpec.parse("shape=flash,base=200,peak=1600,duration=6,"
+                       "at=0.35,width=0.25,seed=17")
+    assert (s.shape, s.base_rps, s.peak_rps) == ("flash", 200.0, 1600.0)
+    assert (s.duration_s, s.at, s.width, s.seed) == (6.0, 0.35, 0.25, 17)
+    # bare defaults
+    s2 = LoadSpec.parse("")
+    assert s2 == LoadSpec()
+    assert LoadSpec.parse("shape=overload,peak=900").shape == "overload"
+
+
+@pytest.mark.parametrize("bad, match", [
+    ("boom=1", "unknown load spec key"),
+    ("shape", "not key=value"),
+    ("peak=lots", "peak=lots"),
+    ("shape=square", "must be one of"),
+    ("base=0", "positive rate"),
+    ("base=500,peak=100", ">= base_rps"),
+    ("duration=0", "must be positive"),
+    ("at=1.5", r"in \[0, 1\]"),
+    ("shape=flash,at=0.9,width=0.3", r"at \+ width <= 1"),
+])
+def test_load_spec_rejects_malformed(bad, match):
+    with pytest.raises(ValueError, match=match):
+        LoadSpec.parse(bad)
+
+
+def test_load_shapes_rate_curves():
+    d = 10.0
+    flash = LoadSpec(shape="flash", base_rps=100, peak_rps=1000,
+                     duration_s=d, at=0.4, width=0.2)
+    assert flash.rate(0.0) == 100 and flash.rate(3.9) == 100
+    assert flash.rate(4.0) == 1000 and flash.rate(5.9) == 1000
+    assert flash.rate(6.0) == 100
+    assert flash.rate(-1) == 0.0 and flash.rate(d) == 0.0
+    over = LoadSpec(shape="overload", base_rps=100, peak_rps=1000,
+                    duration_s=d, at=0.5)
+    ramp = [over.rate(t) for t in (0.0, 1.0, 2.5, 4.0)]
+    assert ramp == sorted(ramp) and ramp[0] == 100  # monotone ramp
+    assert over.rate(5.0) == over.rate(9.9) == 1000  # sustained hold
+    di = LoadSpec(shape="diurnal", base_rps=100, peak_rps=1000,
+                  duration_s=d)
+    assert di.rate(0.0) == pytest.approx(100)
+    assert di.rate(5.0) == pytest.approx(1000)  # peak mid-cycle
+    assert 100 < di.rate(2.5) < 1000
+
+
+def test_load_offsets_same_seed_same_curve():
+    """The determinism pin: same seed => bitwise-identical offered
+    load; different seed => a different schedule."""
+    spec = LoadSpec(shape="flash", base_rps=100, peak_rps=800,
+                    duration_s=4.0, at=0.5, width=0.25, seed=7)
+    a, b = spec.offsets(), spec.offsets()
+    np.testing.assert_array_equal(a, b)
+    c = LoadSpec(shape="flash", base_rps=100, peak_rps=800,
+                 duration_s=4.0, at=0.5, width=0.25, seed=8).offsets()
+    assert len(a) != len(c) or (a[:len(c)] != c[:len(a)]).any()
+    assert np.all(np.diff(a) >= 0)  # sorted arrivals
+    assert a[0] >= 0 and a[-1] < 4.0
+    # the flash window actually carries the peak: arrival density in
+    # [2.0, 3.0) dwarfs the base-rate window [0.0, 1.0)
+    in_flash = int(np.sum((a >= 2.0) & (a < 3.0)))
+    in_base = int(np.sum(a < 1.0))
+    assert in_flash > 3 * in_base
+
+
+# -- the burn-rate evidence (hand-computed) ---------------------------
+
+def test_burn_rates_hand_computed():
+    clk = Clock()
+    m = make_plane(clk)
+    # batch: 4 bad of 20 => attainment 0.8, err 0.2, budget 0.05,
+    # burn 4.0; interactive: no traffic => None, never 100%
+    feed(m, n_bad=4, n_good=16, cls="batch")
+    ev = SloEvaluator(m.registry, classes=CLASSES, windows_s=(60.0,))
+    rec = ev.burn_rates(now=clk())
+    assert rec["batch"]["total"] == 20 and rec["batch"]["good"] == 16
+    assert rec["batch"]["attainment"] == pytest.approx(0.8)
+    assert rec["batch"]["burn_rate"] == pytest.approx(4.0)
+    assert rec["interactive"]["burn_rate"] is None
+    # the window ages the evidence out
+    clk.t += 120
+    rec = ev.burn_rates(now=clk())
+    assert rec["batch"]["burn_rate"] is None
+
+
+def test_deadline_shed_counts_slo_bad_regardless_of_wait():
+    """Survivorship-bias guard: a deadline-shed request lands on its
+    class's deadline-miss counter and the evaluator folds it into
+    attainment as SLO-BAD — a miss is bad whatever it waited, so the
+    burn signal sees overload even when callers run deadlines TIGHTER
+    than the class threshold (a waited-time latency sample would have
+    read such a death as 'good')."""
+    clk = Clock()
+    m = make_plane(clk)
+    # batch threshold is 500ms; these requests died at 50ms — still
+    # SLO-bad, every one of them
+    for _ in range(10):
+        m.record_shed("deadline", slo_class="batch")
+    ev = SloEvaluator(m.registry, classes=CLASSES, windows_s=(60.0,))
+    rec = ev.burn_rates(now=clk())
+    assert rec["batch"]["total"] == 10 and rec["batch"]["good"] == 0
+    assert rec["batch"]["missed"] == 10
+    assert rec["batch"]["attainment"] == 0.0
+    assert m.shed_deadline == 10
+    # misses COMPOSE with served samples: 10 missed + 10 served-good
+    # => attainment 0.5, burn 10 (budget 0.05)
+    feed(m, n_bad=0, n_good=10, good_s=0.005)
+    rec = ev.burn_rates(now=clk())
+    assert rec["batch"]["total"] == 20 and rec["batch"]["good"] == 10
+    assert rec["batch"]["attainment"] == pytest.approx(0.5)
+    assert rec["batch"]["burn_rate"] == pytest.approx(10.0)
+    # evaluate() shares the same window arithmetic (one definition)
+    full = ev.evaluate(now=clk())
+    assert full["classes"]["batch"]["windows"]["60s"] == rec["batch"]
+    # admission sheds deliberately do NOT count as misses (the
+    # controller's own shedding must not feed back into its trigger)
+    m.record_admission_shed("batch")
+    assert ev.burn_rates(now=clk())["batch"]["missed"] == 10
+    # ...and the miss evidence ages out with the window
+    clk.t += 120
+    assert ev.burn_rates(now=clk())["batch"]["burn_rate"] is None
+
+
+def test_admission_shed_counters_and_rate():
+    clk = Clock()
+    m = make_plane(clk)
+    for _ in range(6):
+        m.record_admission_shed("batch")
+    m.record_admission_shed("shadow")
+    snap = m.snapshot()
+    assert snap["shed_admission"] == 7 and m.shed_admission == 7
+    assert snap["requests_shed_by_class"] == {"batch": 6, "shadow": 1}
+    assert m.registry.lookup(SHED_CLASS_METRIC,
+                             labels={"class": "batch"}).value == 6
+    assert admission_shed_rate(m.registry, 10.0,
+                               now=clk()) == pytest.approx(0.7)
+    clk.t += 100  # rate ages out with the window
+    assert admission_shed_rate(m.registry, 10.0, now=clk()) == 0.0
+
+
+def test_queue_residency_family_records():
+    clk = Clock()
+    m = make_plane(clk)
+    m.record_batch(4, 4, latencies=[0.01] * 4,
+                   stage_seconds={"queue": [0.2, 0.3, 0.4, 0.5]})
+    hist = m.registry.lookup(QUEUE_RESIDENCY_METRIC)
+    assert hist is not None and hist.count == 4
+    assert hist.percentile(95, window_s=60.0,
+                           now=clk()) == pytest.approx(0.5)
+
+
+# -- AdmissionController ----------------------------------------------
+
+def make_controller(m, **kw):
+    kw.setdefault("classes", CLASSES)
+    kw.setdefault("shed_order", ("shadow", "batch"))
+    kw.setdefault("window_s", 5.0)
+    kw.setdefault("interval_s", 0.05)
+    kw.setdefault("escalate_ticks", 2)
+    kw.setdefault("relax_ticks", 3)
+    kw.setdefault("min_window_requests", 10)
+    return AdmissionController(m, **kw)
+
+
+def test_controller_validates():
+    m = make_plane(Clock())
+    with pytest.raises(ValueError, match="shed_order"):
+        AdmissionController(m, classes=CLASSES, shed_order=())
+    with pytest.raises(ValueError, match="protected"):
+        AdmissionController(m, classes=CLASSES,
+                            shed_order=("interactive", "batch"))
+    with pytest.raises(ValueError, match="positive"):
+        make_controller(m, window_s=0)
+    with pytest.raises(ValueError, match=">= 1"):
+        make_controller(m, escalate_ticks=0)
+
+
+def test_controller_escalates_one_class_at_a_time():
+    """The hand-computed shed fixture: batch burn 4.0 with 400ms queue
+    residency corroborating => shadow sheds after escalate_ticks,
+    batch after another escalate_ticks, interactive NEVER."""
+    clk = Clock()
+    m = make_plane(clk)
+    ctl = make_controller(m)
+    feed(m, n_bad=8, n_good=12)
+    assert ctl.decide(clk())["triggered"] == ["batch"]
+    assert ctl.level == 0  # one tick is not escalation
+    ctl.decide(clk())
+    assert ctl.level == 1 and ctl.shed_classes() == ("shadow",)
+    assert not ctl.admit("shadow", now=clk.t)
+    assert ctl.admit("batch", now=clk.t)
+    ctl.decide(clk())
+    ctl.decide(clk())
+    assert ctl.level == 2 and ctl.shed_classes() == ("batch", "shadow")
+    assert not ctl.admit("batch", now=clk.t)
+    assert ctl.admit("interactive", now=clk.t)  # protected, always
+    for _ in range(10):  # escalation is BOUNDED by the shed order
+        ctl.decide(clk())
+    assert ctl.level == 2
+
+
+def test_controller_burn_without_queue_never_sheds():
+    """The corroboration gate: slow-but-served traffic with an empty
+    queue is not overload — burn alone must not shed."""
+    clk = Clock()
+    m = make_plane(clk)
+    ctl = make_controller(m)
+    feed(m, n_bad=8, n_good=12, queue_s=0.001)  # 1ms queue residency
+    for _ in range(6):
+        d = ctl.decide(clk())
+    assert d["triggered"] == ["batch"] and not d["corroborated"]
+    assert ctl.level == 0 and ctl.admit("shadow", now=clk.t)
+
+
+def test_controller_thin_evidence_never_sheds():
+    clk = Clock()
+    m = make_plane(clk)
+    ctl = make_controller(m, min_window_requests=30)
+    feed(m, n_bad=8, n_good=12)  # 20 < 30: not enough evidence
+    for _ in range(4):
+        ctl.decide(clk())
+    assert ctl.level == 0
+
+
+def test_controller_relaxes_slowly_with_hysteresis():
+    clk = Clock()
+    m = make_plane(clk)
+    ctl = make_controller(m)
+    feed(m, n_bad=8, n_good=12)
+    for _ in range(4):
+        ctl.decide(clk())
+    assert ctl.level == 2
+    clk.t += 10  # the bad window ages out entirely
+    feed(m, n_bad=0, n_good=20, queue_s=0.001)
+    ctl.decide(clk())
+    ctl.decide(clk())
+    assert ctl.level == 2  # 2 clean ticks < relax_ticks: still shed
+    ctl.decide(clk())
+    assert ctl.level == 1  # relax one LEVEL per relax_ticks
+    for _ in range(3):
+        ctl.decide(clk())
+    assert ctl.level == 0 and ctl.shed_classes() == ()
+    assert ctl.admit("batch", now=clk.t)
+
+
+def test_admit_caches_by_interval():
+    """admit() is the submit-path call: at most one evaluation per
+    interval_s, everything between is a cached set lookup."""
+    clk = Clock()
+    m = make_plane(clk)
+    ctl = make_controller(m, interval_s=1.0)
+    for _ in range(50):
+        ctl.admit("batch", now=clk.t)
+    assert ctl.evaluations == 1
+    clk.t += 1.1
+    ctl.admit("batch", now=clk.t)
+    assert ctl.evaluations == 2
+
+
+# -- elastic fleet: router add/remove ---------------------------------
+
+def test_router_add_replica_routes_and_validates():
+    engine = make_engine()
+    router = FailoverRouter(ReplicaSet(engine, 1), policy="round_robin")
+    assert router.fleet_size() == 1
+    rid = router.add_replica(Replica(1, engine))
+    assert rid == 1 and router.fleet_size() == 2
+    X = np.random.RandomState(0).randn(2, D).astype(np.float32)
+    router.predict(X)
+    router.predict(X)  # round robin reaches the new replica
+    assert router.replicas[1].dispatches == 1
+    assert router.replica_stats()["fleet_size"] == 2
+    with pytest.raises(ValueError, match="already in the fleet"):
+        router.add_replica(Replica(1, engine))
+    other = make_engine()
+    with pytest.raises(ValueError, match="ONE engine"):
+        router.add_replica(Replica(2, other))
+
+
+def test_router_remove_replica_retires_from_routing():
+    engine = make_engine()
+    router = FailoverRouter(ReplicaSet(engine, 3), policy="round_robin")
+    router.remove_replica(1)
+    assert router.fleet_size() == 2
+    X = np.random.RandomState(0).randn(1, D).astype(np.float32)
+    for _ in range(4):
+        router.predict(X)
+    assert router.replicas[0].dispatches + \
+        router.replicas[1].dispatches == 4
+    stats = router.replica_stats()
+    assert stats["removed_replicas"] == 1
+    assert set(stats["replicas"]) == {"0", "2"}
+    with pytest.raises(KeyError):
+        router.remove_replica(7)
+    router.remove_replica(0)
+    with pytest.raises(ValueError, match="last replica"):
+        router.remove_replica(2)
+
+
+def test_replica_service_rate_models_capacity():
+    """The capacity model: a throttled replica's dispatches wait for
+    the replica to come free — back-to-back work takes at least
+    rows/rate end to end."""
+    engine = make_engine()
+    with pytest.raises(ValueError, match="positive rows/s"):
+        Replica(0, engine, service_rate_rows_s=-1)
+    rep = Replica(0, engine, service_rate_rows_s=200.0)
+    X = np.random.RandomState(0).randn(8, D).astype(np.float32)
+    t0 = time.perf_counter()
+    rep.predict(X)  # reserves 40ms; returns without waiting
+    rep.predict(X)  # waits for the replica to free: >= ~40ms
+    rep.predict(X)  # >= ~80ms cumulative wait
+    assert time.perf_counter() - t0 >= 0.08
+    # rate=None replicas stay bit-identical to a bare engine call
+    free = Replica(1, engine)
+    np.testing.assert_array_equal(free.predict(X), engine.predict(X))
+
+
+# -- Autoscaler --------------------------------------------------------
+
+def make_scaler(router, m, clk, **kw):
+    engine = router.engine
+    kw.setdefault("classes", CLASSES)
+    kw.setdefault("window_s", 5.0)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("up_ticks", 2)
+    kw.setdefault("down_ticks", 3)
+    kw.setdefault("cooldown_s", 1.0)
+    kw.setdefault("scale_down_burn", 0.25)
+    kw.setdefault("min_window_requests", 10)
+    return Autoscaler(router, lambda rid: Replica(rid, engine), m,
+                      clock=clk, **kw)
+
+
+def test_autoscaler_validates():
+    engine = make_engine()
+    router = FailoverRouter(ReplicaSet(engine, 1))
+    m = make_plane(Clock())
+    with pytest.raises(ValueError, match="hysteresis"):
+        make_scaler(router, m, Clock(), scale_down_burn=1.5)
+    with pytest.raises(ValueError, match="min_replicas"):
+        make_scaler(router, m, Clock(), min_replicas=0)
+    with pytest.raises(ValueError, match=">= 1"):
+        make_scaler(router, m, Clock(), up_ticks=0)
+
+
+def test_autoscaler_scales_up_under_flash_crowd():
+    """The flash-crowd pin, clock-driven: clean traffic holds, the
+    burn spike scales up after up_ticks (cooldown gating each step)
+    up to max_replicas and never past."""
+    clk = Clock()
+    m = make_plane(clk)
+    engine = make_engine()
+    router = FailoverRouter(ReplicaSet(engine, 1),
+                            policy="round_robin")
+    asc = make_scaler(router, m, clk, max_replicas=3)
+    feed(m, n_bad=0, n_good=20, queue_s=0.001)
+    for _ in range(5):
+        assert asc.tick(clk())["action"] == "hold"
+    assert router.fleet_size() == 1
+    # the crowd arrives: burn 4.0, 400ms queue residency
+    clk.t += 1
+    feed(m, n_bad=8, n_good=12)
+    assert asc.tick(clk())["action"] == "hold"  # tick 1 of up_ticks=2
+    rec = asc.tick(clk())
+    assert rec["action"] == "up" and router.fleet_size() == 2
+    assert rec["attach_ms"] >= 0 and rec["replica_id"] == 1
+    # cooldown holds the next step
+    clk.t += 0.2
+    asc.tick(clk())
+    asc.tick(clk())
+    assert router.fleet_size() == 2
+    clk.t += 1.0  # cooldown over; evidence still burning
+    asc.tick(clk())
+    asc.tick(clk())
+    assert router.fleet_size() == 3 and asc.scale_ups == 2
+    clk.t += 1.0  # max-fleet bound: never past max_replicas
+    for _ in range(6):
+        asc.tick(clk())
+    assert router.fleet_size() == 3
+    assert [e["action"] for e in asc.events] == ["up", "up"]
+
+
+def test_autoscaler_shed_rate_alone_scales_up():
+    """Policy-shed traffic IS unserved demand: once the controller
+    sheds, the served remainder looks healthy — the shed-rate signal
+    must scale the fleet without waiting for burn or queue to re-age."""
+    clk = Clock()
+    m = make_plane(clk)
+    engine = make_engine()
+    router = FailoverRouter(ReplicaSet(engine, 1))
+    asc = make_scaler(router, m, clk, up_ticks=1)
+    m.record_admission_shed("batch")
+    rec = asc.tick(clk())
+    assert rec["action"] == "up" and rec["shed_rate"] > 0
+    assert router.fleet_size() == 2
+
+
+def test_autoscaler_scales_down_with_hysteresis_and_floor():
+    clk = Clock()
+    m = make_plane(clk)
+    engine = make_engine()
+    router = FailoverRouter(ReplicaSet(engine, 1))
+    asc = make_scaler(router, m, clk, up_ticks=1, down_ticks=3)
+    feed(m, n_bad=8, n_good=12)
+    asc.tick(clk())
+    clk.t += 2
+    feed(m, n_bad=8, n_good=12)
+    asc.tick(clk())
+    assert router.fleet_size() == 3
+    # quiet: the bad window ages out entirely, no sheds, no queue
+    clk.t += 20
+    assert asc.tick(clk())["action"] == "hold"  # quiet tick 1
+    asc.tick(clk())
+    assert router.fleet_size() == 3  # 2 quiet ticks < down_ticks
+    rec = asc.tick(clk())
+    assert rec["action"] == "down" and router.fleet_size() == 2
+    assert rec["replica_id"] == 2  # last added goes first
+    clk.t += 2  # cooldown, then the remaining added replica
+    for _ in range(3):
+        asc.tick(clk())
+    assert router.fleet_size() == 1 and asc.scale_downs == 2
+    # the floor: the founding replica is never the autoscaler's to take
+    clk.t += 5
+    for _ in range(8):
+        asc.tick(clk())
+    assert router.fleet_size() == 1
+
+
+def test_autoscaler_dead_band_holds():
+    """Burn between the down and up thresholds is the hysteresis dead
+    band: no action, ever — the no-flap pin."""
+    clk = Clock()
+    m = make_plane(clk)
+    engine = make_engine()
+    router = FailoverRouter(ReplicaSet(engine, 1))
+    asc = make_scaler(router, m, clk, up_ticks=1, down_ticks=2,
+                      scale_up_burn=1.0, scale_down_burn=0.25)
+    # batch: 1 bad of 20 => burn 1.0 — NOT > up threshold, not < 0.25
+    feed(m, n_bad=1, n_good=19)
+    for _ in range(10):
+        assert asc.tick(clk())["action"] == "hold"
+    assert asc.events == [] and router.fleet_size() == 1
+
+
+def test_autoscaler_replica_seconds_integral():
+    clk = Clock()
+    m = make_plane(clk)
+    engine = make_engine()
+    router = FailoverRouter(ReplicaSet(engine, 2))
+    asc = make_scaler(router, m, clk, up_ticks=1)
+    clk.t += 10  # 2 replicas for 10s
+    assert asc.replica_seconds(clk()) == pytest.approx(20.0)
+    feed(m, n_bad=8, n_good=12)
+    asc.tick(clk())  # -> 3 replicas at t+10
+    clk.t += 5  # 3 replicas for 5s
+    assert asc.replica_seconds(clk()) == pytest.approx(35.0)
+
+
+def test_overload_rejection_is_class_attributed():
+    """A max_queue rejection is a door shed like an admission shed:
+    it must land on the per-class shed family (the autoscaler's
+    capacity-shortfall signal), not vanish into a classless counter
+    while the survivors read healthy."""
+    engine = make_engine()
+    with ServingService(engine, max_queue=0) as svc:
+        x = np.random.RandomState(0).randn(1, D).astype(np.float32)
+        from fedamw_tpu.serving import Overloaded
+
+        with pytest.raises(Overloaded):
+            svc.submit(x, slo_class="interactive")
+        snap = svc.metrics.snapshot(engine)
+    assert snap["shed_overload"] == 1
+    assert snap["requests_shed_by_class"] == {"interactive": 1}
+    assert admission_shed_rate(svc.metrics.registry, 60.0) > 0
+
+
+def test_autoscaler_forgets_externally_removed_replica():
+    """An operator removing the autoscaler's replica out from under
+    it must not wedge scale-in forever: the KeyError prunes the stale
+    id and the next quiet period removes the remaining added one."""
+    clk = Clock()
+    m = make_plane(clk)
+    engine = make_engine()
+    router = FailoverRouter(ReplicaSet(engine, 1))
+    asc = make_scaler(router, m, clk, up_ticks=1, down_ticks=1,
+                      cooldown_s=0.0)
+    feed(m, n_bad=8, n_good=12)
+    asc.tick(clk())
+    clk.t += 2
+    feed(m, n_bad=8, n_good=12)
+    asc.tick(clk())
+    assert router.fleet_size() == 3
+    router.remove_replica(2)  # the operator takes the last-added one
+    clk.t += 20  # quiet: everything aged out
+    rec = asc.tick(clk())
+    assert rec["action"] == "error" and asc.errors == 1
+    rec = asc.tick(clk())  # the stale id is forgotten: shrink works
+    assert rec["action"] == "down" and rec["replica_id"] == 1
+    assert router.fleet_size() == 1
+
+
+def test_autoscaler_factory_error_counted_not_fatal():
+    clk = Clock()
+    m = make_plane(clk)
+    engine = make_engine()
+    router = FailoverRouter(ReplicaSet(engine, 1))
+
+    def boom(rid):
+        raise RuntimeError("artifact missing")
+
+    asc = Autoscaler(router, boom, m, classes=CLASSES, window_s=5.0,
+                     up_ticks=1, scale_down_burn=0.25, clock=clk,
+                     min_window_requests=10)
+    feed(m, n_bad=8, n_good=12)
+    rec = asc.tick(clk())
+    assert rec["action"] == "error" and asc.errors == 1
+    assert router.fleet_size() == 1
+
+
+# -- deadline scheduling (EDF) ----------------------------------------
+
+class _R:
+    def __init__(self, deadline, t_submit):
+        self.deadline = deadline
+        self.t_submit = t_submit
+
+
+def test_edf_order_pure():
+    a = _R(5.0, 1.0)
+    b = _R(2.0, 2.0)
+    c = _R(None, 0.5)
+    d = _R(2.0, 1.5)
+    out = edf_order([a, b, c, d])
+    # soonest deadline first; FIFO among equals; no-deadline last
+    assert out == [d, b, a, c]
+    # all-deadline-free: byte-identical FIFO (the clean-load path)
+    e, f = _R(None, 1.0), _R(None, 2.0)
+    assert edf_order([e, f]) == [e, f]
+    assert edf_order([f, e]) == [e, f]
+
+
+class _SlowFirstEngine:
+    """Engine front whose FIRST dispatch stalls — the window in which
+    the EDF test queues its out-of-order-deadline requests."""
+
+    def __init__(self, engine, stall_s=0.25):
+        self._engine = engine
+        self._stall = stall_s
+        self._calls = 0
+        self.buckets = (1, 2)
+        self.input_dim = engine.input_dim
+
+    def predict(self, X, **kw):
+        self._calls += 1
+        if self._calls == 1:
+            time.sleep(self._stall)
+        return self._engine.predict(X, **kw)
+
+
+def test_service_dispatches_soonest_deadline_first_under_pressure():
+    """Three queued requests against a 2-row ladder: the worker must
+    serve the two soonest deadlines and defer the most patient, in
+    deadline order — not arrival order."""
+    engine = make_engine()
+    front = _SlowFirstEngine(engine)
+    order, lock = [], threading.Lock()
+
+    def tag(name):
+        def cb(fut):
+            with lock:
+                order.append(name)
+        return cb
+
+    x = np.random.RandomState(0).randn(1, D).astype(np.float32)
+    with ServingService(front, max_queue=64) as svc:
+        first = svc.submit(x, timeout_s=30.0)
+        first.add_done_callback(tag("first"))
+        time.sleep(0.05)  # the worker is inside the stalled dispatch
+        # arrival order is the REVERSE of deadline order
+        for name, to in (("patient", 20.0), ("mid", 10.0),
+                         ("urgent", 5.0)):
+            svc.submit(x, timeout_s=to).add_done_callback(tag(name))
+        time.sleep(0.02)
+        deadline = time.time() + 10
+        while len(order) < 4 and time.time() < deadline:
+            time.sleep(0.01)
+    # dispatch 2 carries [urgent, mid] (2-row cap), "patient" defers
+    assert order[0] == "first"
+    assert order.index("urgent") < order.index("patient")
+    assert order.index("mid") < order.index("patient")
+
+
+def test_edf_aging_bounds_deferral_of_deadline_free_requests():
+    """Starvation guard: pure EDF sorts a deadline-FREE request last
+    every cycle, and a sustained deadline'd stream would defer it
+    forever. Aging (EDF_MAX_DEFERRALS) exempts it to the front after
+    a bounded number of deferrals — it must resolve well before the
+    deadline'd tail, not after it."""
+    engine = make_engine(buckets=(1,))  # one row per dispatch
+    order, lock = [], threading.Lock()
+
+    def tag(name):
+        def cb(fut):
+            with lock:
+                order.append(name)
+        return cb
+
+    x = np.random.RandomState(0).randn(1, D).astype(np.float32)
+    with ServingService(engine, max_queue=256) as svc:
+        # a pre-queued pressure train, then the deadline-free request,
+        # then MORE deadline'd traffic behind it: every cycle's EDF
+        # window holds a sooner deadline than "free"'s (none)
+        for i in range(10):
+            svc.submit(x, timeout_s=30.0).add_done_callback(
+                tag(f"a{i}"))
+        free = svc.submit(x)  # no deadline: pure EDF would starve it
+        free.add_done_callback(tag("free"))
+        for i in range(15):
+            svc.submit(x, timeout_s=30.0).add_done_callback(
+                tag(f"b{i}"))
+        free.result(timeout=30)
+        deadline = time.time() + 20
+        while len(order) < 26 and time.time() < deadline:
+            time.sleep(0.01)
+    assert len(order) == 26
+    # bounded deferral: "free" dispatched within EDF_MAX_DEFERRALS-ish
+    # cycles of the deadline'd traffic overtaking it — NOT last
+    assert order.index("free") < order.index("b10")
+
+
+# -- the typed shed outcome through the service -----------------------
+
+class _StubAdmission:
+    """Duck-typed controller: sheds exactly the named classes —
+    isolates the service wiring from the controller's dynamics."""
+
+    def __init__(self, shed):
+        self.shed = set(shed)
+
+    def admit(self, slo_class, now=None):
+        return slo_class not in self.shed
+
+
+def test_admission_shed_resolves_future_typed_with_span():
+    engine = make_engine()
+    tracer = Tracer()
+    with ServingService(engine, tracer=tracer,
+                        admission=_StubAdmission({"batch"})) as svc:
+        x = np.random.RandomState(0).randn(2, D).astype(np.float32)
+        shed_fut = svc.submit(x, slo_class="batch")
+        ok_fut = svc.submit(x, slo_class="interactive")
+        # the shed future is ALREADY resolved, with the typed error —
+        # not Overloaded, not DeadlineExceeded
+        with pytest.raises(AdmissionShed, match="batch"):
+            shed_fut.result(timeout=0)
+        ok_fut.result(timeout=30)
+        snap = svc.metrics.snapshot(engine)
+    assert snap["shed_admission"] == 1
+    assert snap["requests_shed_by_class"] == {"batch": 1}
+    assert snap["shed_deadline"] == 0  # NOT the deadline path
+    assert snap["requests"] == 1  # the interactive one served
+    # exactly one span per submitted id — the shed one included, with
+    # the shed annotation naming class and policy
+    spans = {s["trace_id"]: s for s in tracer.records()
+             if s["name"] == "request"}
+    assert set(spans) == {shed_fut.request_id, ok_fut.request_id}
+    assert spans[shed_fut.request_id]["attrs"]["outcome"] == "shed"
+    assert spans[ok_fut.request_id]["attrs"]["outcome"] == "ok"
+    ann = [s for s in tracer.records() if s["name"] == "shed"]
+    assert len(ann) == 1
+    assert ann[0]["trace_id"] == shed_fut.request_id
+    assert ann[0]["attrs"]["slo_class"] == "batch"
+    assert ann[0]["attrs"]["policy"] == "admission"
+
+
+def test_interactive_protected_under_sustained_overload():
+    """The end-to-end protection pin (real time): a throttled fleet
+    offered ~2x its capacity with the controller attached — batch
+    sheds (policy, counted per class), interactive attainment stays
+    far above batch's, nothing is lost, every accepted request
+    resolves typed."""
+    engine = make_engine()
+    metrics = ServeMetrics(registry=Registry())
+    classes = (SloClass("interactive", threshold_ms=150.0,
+                        objective=0.8),
+               SloClass("batch", threshold_ms=400.0, objective=0.5))
+    ctl = AdmissionController(
+        metrics, classes=classes, shed_order=("batch",),
+        window_s=0.5, burn_threshold=1.0, min_window_requests=6,
+        queue_floor_ms=40.0, interval_s=0.01, escalate_ticks=1,
+        relax_ticks=40)
+    router = FailoverRouter(
+        ReplicaSet(engine, 1, service_rate_rows_s=400.0),
+        policy="round_robin", registry=metrics.registry)
+    spec = LoadSpec(shape="overload", base_rps=40, peak_rps=160,
+                    duration_s=2.0, at=0.3, seed=5)
+    offsets = spec.offsets()
+    rng = np.random.RandomState(3)
+    pay = {1: rng.randn(1, D).astype(np.float32),
+           8: rng.randn(8, D).astype(np.float32)}
+    mix = [("interactive", 1, 0.4), ("batch", 8, 1.5)]
+    outcomes = {"interactive": [], "batch": []}
+    with ServingService(router, metrics=metrics, max_queue=4096,
+                        admission=ctl) as svc:
+        t0 = time.perf_counter()
+        futs = []
+        for i, off in enumerate(offsets):
+            lag = t0 + off - time.perf_counter()
+            if lag > 0:
+                time.sleep(lag)
+            cls, rows, to = mix[i % len(mix)]
+            futs.append((cls, time.perf_counter(),
+                         svc.submit(pay[rows], timeout_s=to,
+                                    slo_class=cls)))
+        for cls, t_sub, f in futs:
+            try:
+                f.result(timeout=60)
+                outcomes[cls].append("ok")
+            except AdmissionShed:
+                outcomes[cls].append("shed")
+            except Exception as e:
+                outcomes[cls].append(type(e).__name__)
+        snap = metrics.snapshot(router)
+    allowed = {"ok", "shed", "DeadlineExceeded"}
+    assert all(o in allowed
+               for recs in outcomes.values() for o in recs)  # no loss
+    # batch was policy-shed; interactive never was
+    assert snap["requests_shed_by_class"].get("batch", 0) >= 1
+    assert "interactive" not in snap["requests_shed_by_class"]
+    ok_rate = {cls: recs.count("ok") / len(recs)
+               for cls, recs in outcomes.items()}
+    # the protected class keeps serving while batch is traded away
+    assert ok_rate["interactive"] > ok_rate["batch"]
+    assert ok_rate["interactive"] >= 0.8

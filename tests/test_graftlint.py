@@ -273,6 +273,79 @@ def test_gl003_admission_loop_near_miss_stays_silent(tmp_path):
     assert findings == []
 
 
+def test_gl003_flags_host_sync_in_admission_decision(tmp_path):
+    """The ISSUE 14 hot-path extension: the admission decision runs on
+    EVERY submit — a device sync inside it taxes the admission path
+    itself, exactly what GL003 exists to catch."""
+    findings, _ = lint_src(tmp_path, """
+        import numpy as np
+
+        class AdmissionController:
+            def admit(self, slo_class, engine, x):
+                out = engine.predict(x)
+                out.block_until_ready()
+                return np.asarray(out)
+    """, name="serving/control.py")
+    assert rules_of(findings) == ["GL003"]
+    assert len(findings) == 2
+
+
+def test_gl002_flags_shape_keyed_cache_in_autoscaler_tick(tmp_path):
+    """The autoscaler tick polls against live traffic — a shape-keyed
+    cache there is the recompile-hazard pattern GL002 exists to
+    catch, same as the ladder learner's read path."""
+    findings, _ = lint_src(tmp_path, """
+        class Autoscaler:
+            def tick(self, X):
+                self._cache[X.shape] = 1
+                self._seen.add(X.dtype)
+                return X
+
+        class AdmissionController:
+            def _evaluate(self, now, X):
+                self._plans[X.shape] = now
+    """, name="serving/control.py")
+    assert rules_of(findings) == ["GL002"]
+    assert len(findings) == 3
+
+
+def test_control_plane_near_misses_stay_silent(tmp_path):
+    # the REAL shapes: pure registry reads, cached-set lookups, and
+    # counter arithmetic — no device values, no array-shape keys
+    # anywhere near the decision (shapes in raise messages stay
+    # blessed)
+    findings, _ = lint_src(tmp_path, """
+        class AdmissionController:
+            def admit(self, slo_class, now=None):
+                now = self.clock() if now is None else now
+                with self._lock:
+                    if now - self._last_eval >= self.interval_s:
+                        self._evaluate(now)
+                    return slo_class not in self._shed
+
+            def _evaluate(self, now):
+                burns = self._evaluator.burn_rates(self.window_s,
+                                                   now=now)
+                hot = [n for n, rec in burns.items()
+                       if rec["burn_rate"] is not None
+                       and rec["burn_rate"] > self.burn_threshold]
+                if hot:
+                    self._level = min(self._level + 1,
+                                      len(self.shed_order))
+                self._shed = frozenset(self.shed_order[:self._level])
+
+        class Autoscaler:
+            def tick(self, now, X=None):
+                if X is not None and X.ndim != 2:
+                    raise ValueError(f"bad evidence shape {X.shape}")
+                size = self.router.fleet_size()
+                if size < self.max_replicas and self._hot >= 2:
+                    self.router.add_replica(self.factory(size))
+                return size
+    """, name="serving/control.py")
+    assert findings == []
+
+
 def test_gl003_near_misses_stay_silent(tmp_path):
     # converting the INPUT (host->host) is fine; so is converting a
     # dispatch result outside the hot-path set

@@ -524,6 +524,85 @@ def test_cancelled_mirror_kill_still_marks_dead():
         assert stats["requeues"] == 0
 
 
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_adaptive_hedge_threshold_tracks_live_window():
+    """The ISSUE 14 satellite (ROADMAP carried item): with
+    ``hedge_window_s`` set, the hedge threshold is the percentile of
+    the TRAILING WINDOW's dispatch latencies — it re-arms when the
+    live distribution shifts, where the all-time reservoir would keep
+    hedging against history — and falls back to the reservoir while
+    the window is thin."""
+    from fedamw_tpu.utils.telemetry import Registry
+
+    engine = make_engine()
+    with pytest.raises(ValueError, match="needs a .*registry"):
+        FailoverRouter(ReplicaSet(engine, 2), hedge=True,
+                       hedge_window_s=10.0)
+    clk = _Clock()
+    reg = Registry(clock=clk)
+    router = FailoverRouter(ReplicaSet(engine, 2), hedge=True,
+                            policy="round_robin", registry=reg,
+                            hedge_min_samples=4, hedge_factor=2.0,
+                            hedge_floor_ms=0.01, hedge_window_s=10.0)
+    # 6 real (fast, sub-ms) dispatches land in BOTH the reservoir and
+    # the fleet window series (stamped at the fake clock's now)
+    for k in range(6):
+        router.predict(rows(2, seed=k))
+    fast = router._hedge_timeout_s()
+    assert fast is not None and fast < 0.05
+    # the latency regime SHIFTS: the fast evidence ages out of the
+    # window and the recent window is slow — the adaptive threshold
+    # must track the live distribution (~2x the new p95), not history
+    clk.t += 100.0
+    for _ in range(8):
+        router._fleet_hist.observe(0.05)
+    adaptive = router._hedge_timeout_s()
+    assert adaptive == pytest.approx(0.1, rel=0.05)
+    # the all-time reservoir still remembers only fast dispatches: the
+    # legacy (non-windowed) threshold would be ~100x smaller — the
+    # exact staleness adaptive mode exists to fix
+    assert adaptive > 10 * fast
+    # thin window (everything aged out) => fall back to the reservoir
+    # rather than disarming tail protection
+    clk.t += 100.0
+    thin = router._hedge_timeout_s()
+    assert thin is not None and thin == pytest.approx(fast, rel=0.5)
+
+
+def test_adaptive_hedge_still_masks_a_wedge():
+    """Behavioral twin of the fixed-knob hedge test: with the
+    threshold armed from the rolling window, a wedged dispatch is
+    still mirrored and the mirror still wins."""
+    from fedamw_tpu.utils.telemetry import Registry
+
+    engine = make_engine()
+    plan = ChaosPlan.scripted(2, wedges={0: [2]}, wedge_s=0.5,
+                              horizon=64)
+    reg = Registry()
+    with FailoverRouter(ReplicaSet(engine, 2, chaos=plan),
+                        policy="round_robin", hedge=True,
+                        hedge_min_samples=4, hedge_factor=2.0,
+                        hedge_floor_ms=1.0, registry=reg,
+                        hedge_window_s=60.0) as router:
+        for k in range(4):
+            router.predict(rows(2, seed=k))
+        # the threshold armed from the WINDOW (4 samples >= min), and
+        # the fleet series actually carries the dispatches
+        assert router._fleet_hist.count == 4
+        assert router._hedge_timeout_s() is not None
+        X = rows(3, seed=99)
+        out = router.predict(X)  # r0 wedges -> mirrored to r1
+        np.testing.assert_array_equal(out, engine.predict(X))
+        assert router.hedges == 1 and router.hedge_wins == 1
+
+
 def test_untimed_dispatch_attributes_pinned_version():
     """Hedged-mode attempts run untimed (record_timings=False) and so
     skip the engine's timing slot — the fallback attribution must

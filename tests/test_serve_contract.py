@@ -27,7 +27,11 @@ vs continuous admission over a traffic-learned ladder, paired on one
 seeded open-loop schedule in a v6 ``continuous_batching`` section
 with zero recompiles after ladder freeze, plus the headline mixed
 stream now OPEN-LOOP paced (queue percentiles measure service under
-load: ``queue_depth_peak < requests``); and the strict-backend guard
+load: ``queue_depth_peak < requests``); the ISSUE 14 overload leg —
+the burn-rate admission controller + autoscaled fleet against every
+fixed-N fleet under one seeded flash crowd in a v7 ``overload``
+section, the beat / interactive-protection / zero-lost /
+zero-recompile / exactly-once pins all held; and the strict-backend guard
 — BENCH_STRICT_TPU
 must abort rc=1 on a leaked CPU backend BEFORE measuring anything,
 exactly like bench.py, so a CPU capture can never be harvested as TPU
@@ -159,10 +163,27 @@ def test_serve_bench_emits_driver_contract_json(tmp_path):
     assert cbl["spans_exactly_once"] is True
     assert cbl["ladder"]  # a non-empty learned rung list
 
+    # ISSUE 14 pins — the overload line prints first of the leg lines
+    # (all later positions unmoved, headline still LAST): the elastic
+    # fleet beat every fixed fleet on SLO-good work per
+    # replica-second, interactive held while batch shed, the
+    # autoscaler actually scaled, nothing lost, nothing compiled
+    ov_lines = [l for l in lines if l["metric"] == "serve_overload"]
+    assert len(ov_lines) == 1 and ov_lines[0] == lines[-8]
+    ovl = ov_lines[0]
+    assert ovl["value"] > ovl["best_fixed"] > 0
+    assert ovl["beats_every_fixed"] is True
+    assert ovl["interactive_attainment"] >= 0.8
+    assert ovl["batch_shed"] >= 1
+    assert ovl["scale_ups"] >= 1
+    assert ovl["lost_accepted"] == 0
+    assert ovl["recompiles_during_overload"] == 0
+    assert ovl["spans_exactly_once"] is True
+
     # the artifact mirrors the lines and carries the parity verdict
     with open(out_path) as f:
         art = json.load(f)
-    assert art["schema"] == "BENCH_SERVE.v6"
+    assert art["schema"] == "BENCH_SERVE.v7"
     assert art["recompiles_after_warmup"] == 0
     assert len(art["bucket_latency"]) >= 3
     assert art["parity"]["match"] is True
@@ -333,6 +354,37 @@ def test_serve_bench_emits_driver_contract_json(tmp_path):
         assert ladder["waste_fraction_learned"] < \
             ladder["waste_fraction_fixed"]
     assert art["phases"]["continuous_batching_s"] >= 0
+
+    # the overload section: the v7 contract
+    # (tools/check_bench_schema.py gates it) — every fleet's
+    # attainment-per-replica-second recorded, the autoscaled one on
+    # top, class-aware shedding visible per class, the autoscaler's
+    # event log and attach timings present (scale-out is
+    # load-milliseconds on the artifact plane)
+    ov = art["overload"]
+    fleets = ov["fleets"]
+    assert "autoscaled" in fleets
+    assert any(k.startswith("fixed_") for k in fleets)
+    auto = fleets["autoscaled"]
+    for name, rec in fleets.items():
+        assert rec["requests"] == ov["load"]["requests"]
+        assert rec["replica_seconds"] > 0
+        assert rec["lost"] == 0
+        assert rec["spans_exactly_once"] is True
+        assert rec["recompiles"] == 0
+        if name != "autoscaled":
+            assert auto["good_per_replica_s"] > \
+                rec["good_per_replica_s"]
+    assert auto["scale_ups"] >= 1
+    assert auto["replicas_peak"] > auto["replicas_start"]
+    assert auto["shed_by_class"].get("batch", 0) == ov["batch_shed"] \
+        >= 1
+    assert all(ms >= 0 for ms in auto["attach_ms"])
+    assert any(e["action"] == "up" for e in auto["events"])
+    assert ov["interactive_attainment_ok"] is True
+    assert ov["classes"]["interactive"]["objective"] <= \
+        auto["attainment"]["interactive"]
+    assert art["phases"]["overload_s"] >= 0
 
     # SERVE_TRACE exported the traced leg's spans as readable JSONL
     from fedamw_tpu.utils.trace import read_jsonl

@@ -52,7 +52,14 @@ Three artifact families, three rule sets:
   baseline vs continuous over the learned ladder) present with
   positive tails, the p95 improvement recorded, a non-empty learned
   rung list, and the abort-grade pins re-checked —
-  ``recompiles_after_freeze == 0`` and exactly-once spans.
+  ``recompiles_after_freeze == 0`` and exactly-once spans. From
+  schema v7 on, the ``overload`` section (the ISSUE 14 elastic-
+  serving leg) is required too: the autoscaled-vs-fixed fleet
+  comparison present with attainment-per-replica-second recorded for
+  every fleet, the beat re-checked NUMERICALLY (autoscaled strictly
+  above every fixed fleet), interactive attainment held while batch
+  shed, >= 1 scale-up, zero lost accepted requests, zero recompiles,
+  exactly-once spans.
 - ``MULTICHIP_rNN.json`` — the dryrun wrapper: ``n_devices``/``rc``/
   ``ok``/``tail``, with ``ok`` true iff ``rc == 0`` (a disagreeing
   pair is exactly the silent-green failure this tool exists to catch).
@@ -183,6 +190,7 @@ def check_serve_artifact(art: dict, name: str) -> list[str]:
     errs.extend(_check_cold_start_section(art, schema))
     errs.extend(_check_telemetry_section(art, schema))
     errs.extend(_check_continuous_section(art, schema))
+    errs.extend(_check_overload_section(art, schema))
     return errs
 
 
@@ -474,6 +482,103 @@ def _check_continuous_section(art: dict, schema: str) -> list[str]:
         errs.append("continuous_batching: 'spans_exactly_once' must "
                     "be true (every accepted request id lands one "
                     "span under continuous admission)")
+    return errs
+
+
+def _check_overload_section(art: dict, schema: str) -> list[str]:
+    """The v7+ ``overload`` contract (the ISSUE 14 elastic-serving
+    leg): the autoscaled-vs-fixed fleet comparison must be PRESENT
+    (an ``autoscaled`` record plus at least one ``fixed_*`` record,
+    each with attainment-per-replica-second — positive replica-
+    seconds and a recorded ``good_per_replica_s``), and the
+    abort-grade pins are re-checked numerically at the gate: the
+    autoscaled fleet's good-per-replica-second strictly exceeds EVERY
+    fixed fleet's (the leg's whole claim — a hand-edited artifact
+    where it doesn't must not land green), interactive attainment
+    held its objective while batch shed, at least one scale-up fired,
+    zero lost accepted requests, zero recompiles, exactly-once spans.
+    Earlier schema versions predate the leg and are grandfathered."""
+    if not schema.startswith("BENCH_SERVE."):
+        return []  # family error already reported by the caller
+    version = _schema_version(schema)
+    if version is None:
+        return []  # the rollout check already reported it
+    if version < 7:
+        return []
+    ov = art.get("overload")
+    if not isinstance(ov, dict):
+        return ["schema v7+ requires an 'overload' section (the "
+                "elastic-serving leg)"]
+    errs = []
+    fleets = ov.get("fleets")
+    if not isinstance(fleets, dict) or "autoscaled" not in fleets \
+            or not any(k.startswith("fixed_") for k in fleets):
+        return errs + ["overload: 'fleets' must record the autoscaled "
+                       "fleet AND at least one fixed_* comparator"]
+    for name, rec in fleets.items():
+        if not isinstance(rec, dict):
+            errs.append(f"overload: fleet {name!r} must be a record")
+            continue
+        if not isinstance(rec.get("requests"), int) \
+                or rec["requests"] < 1:
+            errs.append(f"overload: fleet {name} must record a "
+                        "positive request count")
+        if not isinstance(rec.get("replica_seconds"), (int, float)) \
+                or rec["replica_seconds"] <= 0:
+            errs.append(f"overload: fleet {name} missing positive "
+                        "'replica_seconds' (the comparison's "
+                        "denominator)")
+        if not isinstance(rec.get("good_per_replica_s"), (int, float)):
+            errs.append(f"overload: fleet {name} missing numeric "
+                        "'good_per_replica_s' (attainment per "
+                        "replica-second)")
+        if rec.get("lost") != 0:
+            errs.append(f"overload: fleet {name} lost="
+                        f"{rec.get('lost')!r} — every accepted "
+                        "request must resolve typed; a committed "
+                        "artifact may never carry lost requests")
+    auto = fleets.get("autoscaled")
+    if isinstance(auto, dict) and isinstance(
+            auto.get("good_per_replica_s"), (int, float)):
+        for name, rec in fleets.items():
+            if name == "autoscaled" or not isinstance(rec, dict):
+                continue
+            g = rec.get("good_per_replica_s")
+            if isinstance(g, (int, float)) \
+                    and auto["good_per_replica_s"] <= g:
+                errs.append(
+                    f"overload: autoscaled good_per_replica_s="
+                    f"{auto['good_per_replica_s']} must beat {name}'s "
+                    f"{g} — the elastic fleet's whole claim")
+        if not isinstance(auto.get("scale_ups"), int) \
+                or auto["scale_ups"] < 1:
+            errs.append("overload: autoscaled 'scale_ups' must be "
+                        ">= 1 (a leg where the autoscaler never acted "
+                        "proves nothing)")
+    if ov.get("autoscaled_beats_every_fixed") is not True:
+        errs.append("overload: 'autoscaled_beats_every_fixed' must "
+                    "be true")
+    if ov.get("interactive_attainment_ok") is not True:
+        errs.append("overload: 'interactive_attainment_ok' must be "
+                    "true (interactive holds its objective while "
+                    "batch sheds)")
+    if not isinstance(ov.get("batch_shed"), int) \
+            or ov["batch_shed"] < 1:
+        errs.append("overload: 'batch_shed' must be >= 1 (class-aware "
+                    "shedding must actually have shed the batch "
+                    "class)")
+    if ov.get("lost_accepted") != 0:
+        errs.append(f"overload: lost_accepted="
+                    f"{ov.get('lost_accepted')!r} must be 0")
+    if ov.get("recompiles_during_overload") != 0:
+        errs.append("overload: recompiles_during_overload="
+                    f"{ov.get('recompiles_during_overload')!r} — "
+                    "scale-out rides the AOT artifact plane; an "
+                    "elastic fleet must never compile")
+    if ov.get("spans_exactly_once") is not True:
+        errs.append("overload: 'spans_exactly_once' must be true "
+                    "(every submitted request id — shed ones "
+                    "included — lands one span)")
     return errs
 
 

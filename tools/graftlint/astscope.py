@@ -56,6 +56,14 @@ HOT_PATHS = {
         # avoid (install_rung/_warm_shape are deliberately NOT hot:
         # their compile is the budgeted, off-thread cost)
         "LadderLearner.observed_sizes", "LadderLearner.propose"},
+    "serving/control.py": {
+        # the ISSUE 14 control plane: admit runs on EVERY submit (the
+        # cached decision read), _evaluate at the evaluation cadence
+        # against live traffic, tick on the autoscaler thread — a
+        # host sync or shape-keyed cache on any of them taxes the
+        # admission path itself
+        "AdmissionController.admit",
+        "AdmissionController._evaluate", "Autoscaler.tick"},
     "serving/service.py": {
         "ServingService._worker", "ServingService._serve_batch",
         "ServingService._serve_group", "ServingService._shadow_probe",
