@@ -23,7 +23,12 @@ dead-replica requeue and hedged dispatch, proven under seeded
 deterministic chaos), ``artifacts`` (the cold-start plane: AOT-export
 the compiled bucket ladder via jax.export + native executables behind
 a typed artifact/host compatibility contract, so a scaling-out
-replica starts in load-milliseconds with zero compiles). Driven by
+replica starts in load-milliseconds with zero compiles), ``transport``
+(the ISSUE 15 process-boundary seam: the typed ``DispatchTransport``
+interface with the byte-identical in-process path and a stdlib-TCP
+frame protocol + ``PodWorker`` process + ``PodClientEngine`` facade,
+under the seeded ``NetChaosSpec`` network fault grammar — the router
+and control plane work across processes unchanged). Driven by
 ``serve_bench.py`` at the repo root, which emits ``BENCH_SERVE_*.json``
 in the ``bench.py`` schema family with the same strict-backend guard.
 """
@@ -33,7 +38,8 @@ from .artifacts import (ArtifactIncompatible, ArtifactManifest,
 from .batcher import (MicroBatcher, admit, coalesce, drain, edf_order,
                       partition, rung_cut, split_results)
 from .chaos import (ChaosFault, ChaosPlan, ChaosSpec, LoadSpec,
-                    resolve_chaos_plan)
+                    NetChaosPlan, NetChaosSpec, resolve_chaos_plan,
+                    resolve_net_chaos)
 from .control import (DEFAULT_SHED_ORDER, AdmissionController,
                       AdmissionShed, Autoscaler, admission_shed_rate)
 from .engine import DEFAULT_BUCKETS, ServingEngine, bucket_for, infer_model
@@ -46,6 +52,11 @@ from .replica import (FailoverRouter, NoReplicasAvailable, Replica,
 from .rollout import RolloutController, assigned_to_candidate, split_key
 from .service import (DeadlineExceeded, Overloaded, ServiceStopped,
                       ServingService)
+from .transport import (DispatchTransport, FrameError,
+                        InProcessTransport, PodClientEngine, PodWorker,
+                        SocketTransport, TransportError,
+                        TransportRefused, TransportTimeout,
+                        pack_weights, unpack_weights, worker_main)
 
 __all__ = [
     "AdmissionController",
@@ -60,16 +71,23 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "DEFAULT_SHED_ORDER",
     "DeadlineExceeded",
+    "DispatchTransport",
     "FailoverRouter",
+    "FrameError",
+    "InProcessTransport",
     "LadderLearner",
-    "LoadSpec",
     "LadderProposal",
     "LatencyHistogram",
+    "LoadSpec",
     "MicroBatcher",
     "ModelRegistry",
     "ModelVersion",
+    "NetChaosPlan",
+    "NetChaosSpec",
     "NoReplicasAvailable",
     "Overloaded",
+    "PodClientEngine",
+    "PodWorker",
     "Replica",
     "ReplicaDead",
     "ReplicaSet",
@@ -79,6 +97,10 @@ __all__ = [
     "ServiceStopped",
     "ServingEngine",
     "ServingService",
+    "SocketTransport",
+    "TransportError",
+    "TransportRefused",
+    "TransportTimeout",
     "admission_shed_rate",
     "admit",
     "apply_proposal",
@@ -92,10 +114,14 @@ __all__ = [
     "ladder_waste",
     "learn_ladder",
     "load_ladder",
+    "pack_weights",
     "partition",
     "prune_artifacts",
     "resolve_chaos_plan",
+    "resolve_net_chaos",
     "rung_cut",
     "split_key",
     "split_results",
+    "unpack_weights",
+    "worker_main",
 ]

@@ -42,6 +42,13 @@ experiment, not an unbounded hazard. For exact placement (the bench
 kills replica 1 on its 25th dispatch, mid-stream, every run),
 :meth:`ChaosPlan.scripted` builds the cells explicitly instead of by
 rate; both constructions are plain data and fully deterministic.
+
+Two sibling grammars share the determinism contract: :class:`LoadSpec`
+(ISSUE 14) scripts how TRAFFIC arrives, and :class:`NetChaosSpec`
+(ISSUE 15) scripts how the WIRE fails — partition/refuse/lag rates
+plus scripted worker-process SIGKILLs, consumed by
+``serving.transport.SocketTransport`` at the cross-process dispatch
+boundary.
 """
 
 from __future__ import annotations
@@ -398,6 +405,293 @@ class LoadSpec:
             if rs.random_sample() * peak <= self.rate(t):
                 out.append(t)
         return np.asarray(out, dtype=np.float64)
+
+
+#: Network-chaos role codes (int8) for the transport layer (ISSUE 15).
+#: NET_CLEAN must be 0 so a zero-initialized matrix is the clean plan.
+NET_CLEAN, NET_PARTITION, NET_REFUSE, NET_LAG = 0, 1, 2, 3
+
+_NET_ROLE_NAMES = {NET_CLEAN: "clean", NET_PARTITION: "partition",
+                   NET_REFUSE: "refuse", NET_LAG: "lag"}
+
+
+@dataclasses.dataclass(frozen=True)
+class NetChaosSpec:
+    """Seeded NETWORK fault rates for the cross-process pod — the
+    transport-layer twin of :class:`ChaosSpec` (ISSUE 15): where the
+    in-process plan scripts how REPLICAS fail, this scripts how the
+    WIRE fails, under the same determinism contract (same spec ⇒
+    bitwise-identical schedule). Injected at the
+    ``serving.transport.SocketTransport`` dispatch boundary, per
+    ``(host, dispatch)`` cell:
+
+    - **partition**: the route blackholes — the client hangs for
+      ``partition_s`` (bounded by its remaining deadline budget) and
+      times out; the held connection is dropped, exactly what a
+      partitioned route does to an established TCP stream.
+    - **refuse**: the connect (or the exchange) is refused
+      immediately — the worker port answers RST, the fast failure.
+    - **lag**: the hop runs, ``lag_s`` late — cross-rack latency the
+      health plane's EWMA must learn to route around.
+    - **kill_host**: scripted (never sampled) SIGKILL of a worker
+      PROCESS at its K-th dispatch, via the transport's ``kill_cb``
+      hook — the one network fault that is also a host fault, placed
+      exactly so the pod bench kills the same worker mid-stream every
+      run.
+
+    Spec string syntax (mirrors the ``ChaosSpec`` grammar; MS values
+    are milliseconds)::
+
+        partition=0.02:250,refuse=0.05,lag=0.1:20,kill_host=1@12,seed=7
+                  ^rate ^stall_ms      ^rate ^ms   ^host ^dispatch
+
+    ``kill_host`` may repeat (one token per victim).
+    """
+
+    partition: float = 0.0
+    partition_s: float = 0.25
+    refuse: float = 0.0
+    lag: float = 0.0
+    lag_s: float = 0.02
+    kill_host: tuple = ()
+    seed: int = 0
+
+    def __post_init__(self):
+        for name in ("partition", "refuse", "lag"):
+            r = getattr(self, name)
+            if not 0.0 <= r <= 1.0:
+                raise ValueError(
+                    f"net chaos rate {name}={r} must be in [0, 1]")
+        total = self.partition + self.refuse + self.lag
+        if total > 1.0:
+            raise ValueError(
+                "net chaos rates must sum to <= 1 (a dispatch is at "
+                "most one of partition/refuse/lag), got "
+                f"partition+refuse+lag={total}")
+        if not (np.isfinite(self.partition_s) and self.partition_s > 0):
+            raise ValueError(
+                f"partition_s={self.partition_s} must be a positive "
+                "stall (seconds the partitioned dispatch hangs)")
+        if not (np.isfinite(self.lag_s) and self.lag_s >= 0):
+            raise ValueError(
+                f"lag_s={self.lag_s} must be a non-negative added "
+                "latency")
+        # normalize + validate the kill schedule: ((host, dispatch)...)
+        kills = tuple((int(h), int(k)) for h, k in self.kill_host)
+        for h, k in kills:
+            if h < 0 or k < 0:
+                raise ValueError(
+                    f"kill_host {h}@{k}: host and dispatch must be "
+                    ">= 0")
+        if len({h for h, _ in kills}) != len(kills):
+            raise ValueError(
+                "kill_host names one kill per host (a process dies "
+                "once)")
+        object.__setattr__(self, "kill_host", kills)
+
+    @classmethod
+    def parse(cls, text: str) -> "NetChaosSpec":
+        """Parse the spec syntax (class docstring). Unknown keys and
+        malformed values raise ``ValueError`` naming the token — the
+        ``ChaosSpec.parse`` contract on the network axis."""
+        kw: dict = {"kill_host": []}
+        for token in text.split(","):
+            token = token.strip()
+            if not token:
+                continue
+            if "=" not in token:
+                raise ValueError(
+                    f"net chaos spec token {token!r} is not key=value "
+                    "(expected e.g. 'partition=0.02:250,refuse=0.05,"
+                    "kill_host=1@12,seed=7')")
+            key, val = token.split("=", 1)
+            key = key.strip().lower()
+            try:
+                if key == "partition":
+                    rate, _, ms = val.partition(":")
+                    kw["partition"] = float(rate)
+                    if ms:
+                        kw["partition_s"] = float(ms) / 1e3
+                elif key == "lag":
+                    rate, _, ms = val.partition(":")
+                    kw["lag"] = float(rate)
+                    if ms:
+                        kw["lag_s"] = float(ms) / 1e3
+                elif key == "refuse":
+                    kw["refuse"] = float(val)
+                elif key == "seed":
+                    kw["seed"] = int(val)
+                elif key == "kill_host":
+                    host, sep, disp = val.partition("@")
+                    if not sep:
+                        raise ValueError(
+                            "expected HOST@DISPATCH (e.g. 1@12)")
+                    kw["kill_host"].append((int(host), int(disp)))
+                else:
+                    raise ValueError(
+                        f"unknown net chaos spec key {key!r} (expected "
+                        "partition/refuse/lag/kill_host/seed)")
+            except ValueError as e:
+                if "unknown net chaos spec key" in str(e):
+                    raise
+                raise ValueError(
+                    f"net chaos spec token {token!r}: {e}") from None
+        kw["kill_host"] = tuple(kw["kill_host"])
+        return cls(**kw)
+
+
+class NetChaosPlan:
+    """Dense per-``(host, dispatch)`` network fault schedule — the
+    :class:`ChaosPlan` construction on the transport axis. ``roles``
+    is ``(n_hosts, horizon)`` int8 of :data:`NET_CLEAN`/
+    :data:`NET_PARTITION`/:data:`NET_REFUSE`/:data:`NET_LAG` codes;
+    ``kills`` maps host -> the dispatch index its worker process is
+    SIGKILLed at (always scripted — a sampled process death would
+    break the paired-run determinism the pod bench pins). Same spec ⇒
+    identical plan, bitwise. Dispatches past the horizon are clean."""
+
+    def __init__(self, roles, partition_s: float = 0.25,
+                 lag_s: float = 0.02, kills: dict | None = None):
+        roles = np.asarray(roles, np.int8)
+        if roles.ndim != 2:
+            raise ValueError(
+                f"NetChaosPlan roles must be (n_hosts, horizon), got "
+                f"shape {roles.shape}")
+        if roles.size and (roles.min() < NET_CLEAN
+                           or roles.max() > NET_LAG):
+            raise ValueError(
+                f"NetChaosPlan roles must be codes in [{NET_CLEAN}, "
+                f"{NET_LAG}], got range "
+                f"[{roles.min()}, {roles.max()}]")
+        if not (np.isfinite(partition_s) and partition_s > 0):
+            raise ValueError(
+                f"partition_s={partition_s} must be positive")
+        if not (np.isfinite(lag_s) and lag_s >= 0):
+            raise ValueError(f"lag_s={lag_s} must be >= 0")
+        self.roles = roles
+        self.partition_s = float(partition_s)
+        self.lag_s = float(lag_s)
+        self.n_hosts, self.horizon = roles.shape
+        self.kills = {int(h): int(k)
+                      for h, k in (kills or {}).items()}
+        for h, k in self.kills.items():
+            if not 0 <= h < self.n_hosts:
+                raise ValueError(
+                    f"kill_host {h} out of range for a "
+                    f"{self.n_hosts}-host plan")
+            if k < 0:
+                raise ValueError(
+                    f"kill_host {h}@{k}: dispatch index must be >= 0 "
+                    "(the transport fires at k >= kill_at, so a "
+                    "negative index would kill on the FIRST dispatch)")
+
+    @classmethod
+    def build(cls, spec: NetChaosSpec, n_hosts: int,
+              horizon: int = 4096) -> "NetChaosPlan":
+        """Expand a spec over the full horizon: one uniform draw per
+        cell assigns at most one role (partition wins over refuse over
+        lag), kills taken verbatim from the spec's scripted list."""
+        if n_hosts < 1 or horizon < 1:
+            raise ValueError(
+                f"need n_hosts >= 1 and horizon >= 1, got "
+                f"({n_hosts}, {horizon})")
+        rs = np.random.RandomState(spec.seed)
+        u = rs.random_sample((n_hosts, horizon))
+        roles = np.zeros((n_hosts, horizon), np.int8)
+        p = u < spec.partition
+        r = ~p & (u < spec.partition + spec.refuse)
+        lg = ~p & ~r & (u < spec.partition + spec.refuse + spec.lag)
+        roles[p], roles[r], roles[lg] = (NET_PARTITION, NET_REFUSE,
+                                         NET_LAG)
+        return cls(roles, partition_s=spec.partition_s,
+                   lag_s=spec.lag_s, kills=dict(spec.kill_host))
+
+    @classmethod
+    def scripted(cls, n_hosts: int, partitions: dict | None = None,
+                 refuses: dict | None = None, lags: dict | None = None,
+                 kills: dict | None = None, horizon: int | None = None,
+                 partition_s: float = 0.25,
+                 lag_s: float = 0.02) -> "NetChaosPlan":
+        """Exact-placement construction (the pod bench's spelling):
+        ``partitions``/``refuses``/``lags`` map host -> an iterable of
+        dispatch indices; ``kills`` maps host -> the single dispatch
+        its process dies at."""
+        cells = []
+        for role, spec_map in ((NET_PARTITION, partitions),
+                               (NET_REFUSE, refuses), (NET_LAG, lags)):
+            for host, where in (spec_map or {}).items():
+                host = int(host)
+                if not 0 <= host < n_hosts:
+                    raise ValueError(
+                        f"host {host} out of range for a "
+                        f"{n_hosts}-host plan")
+                for i in where:
+                    i = int(i)
+                    if i < 0:
+                        raise ValueError(
+                            f"dispatch index {i} must be >= 0")
+                    cells.append((host, i, role))
+        top = max((i for _, i, _ in cells), default=-1)
+        horizon = (top + 1 if horizon is None else int(horizon))
+        horizon = max(1, horizon)
+        roles = np.zeros((n_hosts, horizon), np.int8)
+        for host, i, role in cells:
+            if i >= horizon:
+                raise ValueError(
+                    f"dispatch index {i} outside the horizon {horizon}")
+            if roles[host, i] != NET_CLEAN:
+                raise ValueError(
+                    f"cell (host {host}, dispatch {i}) assigned two "
+                    f"roles ({_NET_ROLE_NAMES[int(roles[host, i])]} "
+                    f"and {_NET_ROLE_NAMES[role]}) — net chaos roles "
+                    "are mutually exclusive per cell")
+            roles[host, i] = role
+        return cls(roles, partition_s=partition_s, lag_s=lag_s,
+                   kills=kills)
+
+    def role(self, host: int, dispatch: int) -> int:
+        """The role code of one dispatch (clean past the horizon)."""
+        if dispatch >= self.horizon:
+            return NET_CLEAN
+        return int(self.roles[host, dispatch])
+
+    def kill_at(self, host: int) -> int | None:
+        """The dispatch index ``host``'s worker is SIGKILLed at, or
+        None — plan facts, known before anything runs."""
+        return self.kills.get(int(host))
+
+    def counts(self) -> dict:
+        """Planned fault totals over the whole horizon — what the pod
+        bench records beside what actually FIRED."""
+        return {
+            "partition": int(np.sum(self.roles == NET_PARTITION)),
+            "refuse": int(np.sum(self.roles == NET_REFUSE)),
+            "lag": int(np.sum(self.roles == NET_LAG)),
+            "kills": len(self.kills),
+        }
+
+
+def resolve_net_chaos(chaos, n_hosts: int,
+                      horizon: int = 4096) -> NetChaosPlan | None:
+    """Normalize the transport's ``chaos=`` argument: None (clean), a
+    spec string, a :class:`NetChaosSpec`, or a prebuilt
+    :class:`NetChaosPlan` (shape-checked against this pod) — the
+    :func:`resolve_chaos_plan` contract on the network axis."""
+    if chaos is None:
+        return None
+    if isinstance(chaos, str):
+        chaos = NetChaosSpec.parse(chaos)
+    if isinstance(chaos, NetChaosSpec):
+        return NetChaosPlan.build(chaos, n_hosts, horizon)
+    if isinstance(chaos, NetChaosPlan):
+        if chaos.n_hosts < n_hosts:
+            raise ValueError(
+                f"NetChaosPlan covers {chaos.n_hosts} hosts but this "
+                f"pod has {n_hosts}; rebuild the plan")
+        return chaos
+    raise TypeError(
+        f"net chaos must be None, a spec string, a NetChaosSpec or a "
+        f"NetChaosPlan, got {type(chaos).__name__}")
 
 
 def resolve_chaos_plan(chaos, n_replicas: int,

@@ -71,6 +71,7 @@ from concurrent.futures import ThreadPoolExecutor, wait
 from .chaos import CLEAN, FLAKY, KILL, SLOW, WEDGE, ChaosFault, \
     resolve_chaos_plan
 from .metrics import LatencyHistogram
+from .transport import InProcessTransport
 
 
 class ReplicaDead(RuntimeError):
@@ -106,8 +107,20 @@ class Replica:
     """
 
     def __init__(self, replica_id: int, engine, plan=None,
-                 service_rate_rows_s: float | None = None):
-        """``service_rate_rows_s``: an optional per-replica CAPACITY
+                 service_rate_rows_s: float | None = None,
+                 transport=None):
+        """``transport`` (ISSUE 15): the :class:`~serving.transport.
+        DispatchTransport` this replica dispatches through. None (the
+        default) builds an ``InProcessTransport`` over ``engine`` —
+        the extracted direct-call path, byte-identical to the pre-seam
+        behavior; a ``SocketTransport`` makes this replica a remote
+        POD WORKER while every layer above (router health gating,
+        requeue, hedging, the control plane) works unchanged. With a
+        remote transport, ``engine`` is the pod's shared
+        ``PodClientEngine`` facade (the router's one-engine contract
+        then means one POD, exactly as it meant one compiled ladder).
+
+        ``service_rate_rows_s``: an optional per-replica CAPACITY
         model (the load twin of the chaos plan's ``slow`` cells, used
         by the overload bench and the control-plane tests): each
         dispatch reserves ``rows / rate`` seconds of this replica's
@@ -123,6 +136,8 @@ class Replica:
         bit-identical to a bare engine call."""
         self.replica_id = int(replica_id)
         self.engine = engine
+        self.transport = (transport if transport is not None
+                          else InProcessTransport(engine))
         self._plan = plan
         # None disables; anything else must validate — a falsy 0 must
         # hit the error below, not silently mean "infinitely fast"
@@ -144,12 +159,19 @@ class Replica:
             return self._dispatches
 
     def predict(self, X, version: int | None = None,
-                record_timings: bool = True):
-        """One engine dispatch through this replica's chaos boundary.
-        Raises :class:`ReplicaDead` once killed (this dispatch and
-        forever after), :class:`ChaosFault` on wedge/flaky cells, and
-        stretches slow cells by the plan's multiplier; clean cells run
-        the shared engine bit-identically to a direct call."""
+                record_timings: bool = True,
+                deadline: float | None = None, trace_ctx=None):
+        """One engine dispatch through this replica's chaos boundary
+        and transport. Raises :class:`ReplicaDead` once killed (this
+        dispatch and forever after), :class:`ChaosFault` on
+        wedge/flaky cells, and stretches slow cells by the plan's
+        multiplier; clean cells run the transport bit-identically to
+        a direct engine call (``InProcessTransport``). ``deadline``
+        (absolute ``perf_counter``) and ``trace_ctx`` flow to the
+        transport: a socket transport derives its connect/read
+        timeouts from the remaining budget and carries the trace
+        context across the wire; the in-process transport ignores
+        both."""
         with self._lock:
             if self.dead:
                 raise ReplicaDead(
@@ -191,8 +213,10 @@ class Replica:
             if start > now:
                 time.sleep(start - now)
         t0 = time.perf_counter()
-        out = self.engine.predict(X, version=version,
-                                  record_timings=record_timings)
+        out = self.transport.dispatch(X, version=version,
+                                      deadline=deadline,
+                                      trace_ctx=trace_ctx,
+                                      record_timings=record_timings)
         if role == SLOW:
             # proportional, not fixed: a slow replica is slow on big
             # batches too, which is what the EWMA must learn
@@ -656,7 +680,8 @@ class FailoverRouter:
 
     # -- dispatch -----------------------------------------------------
     def _attempt(self, rep: Replica, X, version, record_timings,
-                 cancel: threading.Event | None = None):
+                 cancel: threading.Event | None = None,
+                 deadline: float | None = None, trace_ctx=None):
         """One replica dispatch with health + counter accounting.
         Returns ``(out, timing)``; raises the replica's failure after
         recording it (the caller decides whether to fail over).
@@ -674,9 +699,18 @@ class FailoverRouter:
         with self._lock:
             self._counts[rid]["routed"] += 1
         t0 = time.perf_counter()
+        kw = {}
+        # only forward what is SET: replica subclasses predating the
+        # transport seam (old predict signatures) keep working for
+        # deadline-free dispatch, and passing an explicit deadline to
+        # one fails loudly instead of being silently dropped
+        if deadline is not None:
+            kw["deadline"] = deadline
+        if trace_ctx is not None:
+            kw["trace_ctx"] = trace_ctx
         try:
             out = rep.predict(X, version=version,
-                              record_timings=record_timings)
+                              record_timings=record_timings, **kw)
         except ReplicaDead:
             cancelled = cancel is not None and cancel.is_set()
             with self._lock:
@@ -785,7 +819,8 @@ class FailoverRouter:
             return self._pool
 
     def _dispatch(self, rep: Replica, X, version, record_timings,
-                  excluded: set, failed: set):
+                  excluded: set, failed: set,
+                  deadline: float | None = None, trace_ctx=None):
         """One (possibly hedged) attempt on ``rep``. Returns
         ``(out, timing, winner, hedged)``; raises only when the
         primary — and the mirror, if one launched — failed, adding
@@ -796,7 +831,9 @@ class FailoverRouter:
         if hedge_s is None:
             try:
                 out, timing = self._attempt(rep, X, version,
-                                            record_timings)
+                                            record_timings,
+                                            deadline=deadline,
+                                            trace_ctx=trace_ctx)
             except Exception:
                 failed.add(rep.replica_id)
                 raise
@@ -816,7 +853,8 @@ class FailoverRouter:
         def attributed(timing):
             return {**timing, "version": ver0}
 
-        primary = pool.submit(self._attempt, rep, X, version, False)
+        primary = pool.submit(self._attempt, rep, X, version, False,
+                              deadline=deadline, trace_ctx=trace_ctx)
         try:
             out, timing = primary.result(timeout=hedge_s)
             return out, attributed(timing), rep, False
@@ -838,7 +876,8 @@ class FailoverRouter:
             self.hedges += 1
         cancel_mirror = threading.Event()
         mirror = pool.submit(self._attempt, mirror_rep, X, version,
-                             False, cancel_mirror)
+                             False, cancel_mirror, deadline=deadline,
+                             trace_ctx=trace_ctx)
         pending = {primary: rep, mirror: mirror_rep}
         last_exc: BaseException | None = None
         while pending:
@@ -876,14 +915,18 @@ class FailoverRouter:
 
     def predict(self, X, version: int | None = None,
                 record_timings: bool = True,
-                deadline: float | None = None):
+                deadline: float | None = None, trace_ctx=None):
         """Engine-compatible dispatch with failover (see class
         docstring). ``deadline`` is an absolute ``perf_counter`` time
         (the service passes the batch's earliest request deadline):
         once past it the failover walk stops with a TRANSIENT error,
         letting the service shed exactly the expired requests and
         retry the rest — a requeue never turns into a late success
-        for a request whose caller already gave up."""
+        for a request whose caller already gave up. The deadline also
+        flows INTO each attempt's transport (ISSUE 15), so a socket
+        dispatch bounds its connect/read timeouts by the remaining
+        budget; ``trace_ctx`` (a ``TRACECTX.v1`` carrier) rides along
+        so remote workers join the request's trace."""
         excluded: set = set()
         failovers = 0
         while True:
@@ -897,7 +940,8 @@ class FailoverRouter:
             failed: set = set()
             try:
                 out, timing, winner, hedged = self._dispatch(
-                    rep, X, version, record_timings, excluded, failed)
+                    rep, X, version, record_timings, excluded, failed,
+                    deadline=deadline, trace_ctx=trace_ctx)
             except Exception:
                 # the requeue: EVERY replica that failed this batch —
                 # the primary, and the hedge mirror if one launched

@@ -49,7 +49,7 @@ from concurrent.futures import Future, InvalidStateError
 
 import numpy as np
 
-from ..utils.trace import NULL_TRACER
+from ..utils.trace import NULL_TRACER, inject_context
 from .batcher import (admit, coalesce, drain, edf_order, partition,
                       request_rows, rung_cut, split_results)
 from .control import AdmissionShed
@@ -150,7 +150,8 @@ class ServingService:
                  max_wait_ms: float = 2.0, metrics: ServeMetrics | None = None,
                  retries: int = 2, retry_backoff_ms: float = 5.0,
                  tracer=None, router=None, mode: str = "continuous",
-                 rung_aware: bool = False, admission=None):
+                 rung_aware: bool = False, admission=None,
+                 slo_classes=None):
         """``mode``: batch-formation policy (:data:`MODES`). In
         ``"continuous"`` (default) ``max_wait_ms`` is unused — the
         batching window is the previous dispatch itself; ``"drain"``
@@ -200,7 +201,18 @@ class ServingService:
         the per-class ``serve_requests_shed_total`` counter — the
         surfaces a dashboard needs to tell policy shedding from
         deadline blowouts). None admits everything, the pre-ISSUE-14
-        behavior."""
+        behavior.
+
+        ``slo_classes`` (ISSUE 15, the PR 14 follow-on): an iterable
+        of ``utils.telemetry.SloClass`` giving the class vocabulary
+        its DEADLINES — a ``submit(slo_class="interactive")`` with no
+        explicit ``timeout_s`` gets the class's default timeout
+        (``SloClass.timeout_s()``), so callers stop hand-picking
+        deadlines the vocabulary already implies. An explicit
+        ``timeout_s=`` always wins; classes outside the vocabulary
+        (including the implicit ``"default"``) keep the deadline-free
+        behavior. None (the default) applies no class deadlines —
+        every pre-ISSUE-15 call site is unchanged."""
         if mode not in self.MODES:
             raise ValueError(f"mode must be one of {self.MODES}, "
                              f"got {mode!r}")
@@ -215,6 +227,12 @@ class ServingService:
         self.retries = int(retries)
         self.retry_backoff = retry_backoff_ms / 1e3
         self.metrics = metrics if metrics is not None else ServeMetrics()
+        # the per-class deadline vocabulary (ISSUE 15): resolved once
+        # to a plain name->seconds map so submit pays a dict lookup,
+        # not an attribute walk
+        self._class_timeout = (
+            {} if slo_classes is None
+            else {c.name: c.timeout_s() for c in slo_classes})
         self._width = engine.input_dim  # computed once, checked per submit
         # capability check once, not per probe: whether the engine's
         # predict supports the out-of-band record_timings=False mode
@@ -229,9 +247,14 @@ class ServingService:
             sig_params = inspect.signature(engine.predict).parameters
             self._predict_untimed = "record_timings" in sig_params
             self._predict_deadline = "deadline" in sig_params
+            # whether dispatch can carry a TRACECTX carrier across a
+            # process boundary (a FailoverRouter over SocketTransport
+            # replicas — ISSUE 15); a plain engine has no hop to cross
+            self._predict_trace = "trace_ctx" in sig_params
         except (TypeError, ValueError):
             self._predict_untimed = False
             self._predict_deadline = False
+            self._predict_trace = False
         self._q: queue.Queue[_Request] = queue.Queue()
         # accepted-but-unserved request count, mutated under the lock:
         # a bare qsize()-then-put check is a race (N concurrent submits
@@ -490,6 +513,11 @@ class ServingService:
             raise ValueError(
                 f"request must be a ({self._width},) row or a non-empty "
                 f"(n, {self._width}) batch, got shape {x.shape}")
+        if timeout_s is None:
+            # the class vocabulary's deadline (ISSUE 15): implied by
+            # slo_class, never overriding an explicit timeout_s, and
+            # absent entirely for classes outside the vocabulary
+            timeout_s = self._class_timeout.get(slo_class or "default")
         now = time.perf_counter()
         fut: Future = Future()
         req = _Request(
@@ -847,6 +875,13 @@ class ServingService:
                 kw = {}
                 if use_version is not None:
                     kw["version"] = use_version
+                if self._predict_trace and bid is not None:
+                    # the cross-process trace carrier (ISSUE 15): the
+                    # batch id is the trace a remote worker's
+                    # pod_dispatch span joins — request spans keep
+                    # landing exactly once, router-side, with batch=
+                    # as the join key
+                    kw["trace_ctx"] = inject_context(bid)
                 if self._predict_deadline:
                     # the batch's earliest live deadline bounds the
                     # router's failover walk: a dead replica's batch

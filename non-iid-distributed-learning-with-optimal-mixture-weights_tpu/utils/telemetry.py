@@ -500,11 +500,22 @@ class Registry:
 class SloClass:
     """One service class: a request is GOOD iff its latency lands at or
     under ``threshold_ms``; ``objective`` is the target good-fraction
-    (0.99 = 1% error budget)."""
+    (0.99 = 1% error budget).
+
+    ``default_timeout_s`` (ISSUE 15, the PR 14 follow-on): the request
+    DEADLINE this class implies — what ``ServingService(slo_classes=)``
+    applies when a submit names the class but hand-picks no
+    ``timeout_s``. None derives it as ``4 x threshold_ms``: a request
+    that has already quadrupled its SLO bound is SLO-bad whatever
+    happens next, so holding the caller longer only burns queue
+    residency the control plane charges against everyone else. The
+    vocabulary owning the timeout is what lets callers stop picking
+    deadlines per call; an explicit ``timeout_s=`` still wins."""
 
     name: str
     threshold_ms: float
     objective: float = 0.99
+    default_timeout_s: float | None = None
 
     def __post_init__(self):
         if not 0.0 < self.objective < 1.0:
@@ -515,6 +526,18 @@ class SloClass:
         if self.threshold_ms <= 0:
             raise ValueError(
                 f"threshold_ms must be positive, got {self.threshold_ms}")
+        if self.default_timeout_s is not None \
+                and self.default_timeout_s <= 0:
+            raise ValueError(
+                f"default_timeout_s must be positive when set, got "
+                f"{self.default_timeout_s}")
+
+    def timeout_s(self) -> float:
+        """The class's request deadline, in seconds: the explicit
+        ``default_timeout_s`` when set, else ``4 x threshold_ms``."""
+        return (self.default_timeout_s
+                if self.default_timeout_s is not None
+                else 4.0 * self.threshold_ms / 1e3)
 
 
 #: The default service classes (ROADMAP direction 4's vocabulary):

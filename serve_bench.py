@@ -119,6 +119,23 @@ interactive attainment holds its objective while batch sheds, at
 least one scale-up fires, zero lost accepted requests, zero
 recompiles, exactly-once spans (shed requests included).
 
+The ISSUE 15 pod leg (``pod``, schema BENCH_SERVE.v8) crosses the
+process boundary for real: SERVE_POD_WORKERS (default 3) worker
+PROCESSES each load the cold-start plane's AOT artifact
+(``serving.transport.worker_main``) and serve the length-prefixed
+frame protocol; the parent fronts them with ``PodClientEngine`` +
+per-worker ``SocketTransport`` replicas behind the same
+``FailoverRouter``/``ServingService`` stack, then — under a scripted
+``NetChaosPlan`` — partitions one worker's route, SIGKILLs another
+mid-stream, and broadcasts a mid-stream ``swap_weights`` version
+announce to the pod. Abort-grade: zero lost accepted requests,
+exactly-once request spans with the trace context propagated across
+the wire (workers stream ``pod_dispatch`` spans whose trace ids must
+all be router-sent batch ids), at least one kill and one partition
+actually fired, zero recompiles on every surviving worker (read back
+via ``stats`` frames), and the agreed post-swap version on every
+post-swap span.
+
 Env knobs: SERVE_BUCKETS ("1,8,64,512"), SERVE_D (RFF width, 256),
 SERVE_N (train rows, 4096), SERVE_CLIENTS (8), SERVE_TRAIN_ROUNDS (2),
 SERVE_ITERS (per-bucket timed calls, 30), SERVE_REQUESTS (mixed-stream
@@ -146,6 +163,8 @@ SERVE_OVERLOAD_REPLICA_ROWS_S (modeled per-replica capacity, 1500),
 SERVE_OVERLOAD_MIN_REPLICAS (2) / SERVE_OVERLOAD_MAX_REPLICAS (4),
 SERVE_OVERLOAD_INT_MS (interactive SLO threshold, 100) /
 SERVE_OVERLOAD_INT_OBJECTIVE (0.8),
+SERVE_POD_WORKERS (pod-leg worker processes, default 3, floor 2),
+SERVE_POD_REQUESTS (pod-leg stream length, default 120),
 SERVE_OUT, SERVE_ROUND (artifact suffix, default 1),
 SERVE_TRACE (directory: export the traced leg's span records as JSONL
 there, and stream the rollout leg's spans there as rotating parts),
@@ -1178,6 +1197,253 @@ def overload_bench(ckpt, buckets, max_wait_ms):
     return section
 
 
+def pod_bench(ckpt, buckets, max_wait_ms):
+    """The ISSUE 15 cross-process pod leg (schema BENCH_SERVE.v8):
+    the serving plane's first REAL process boundary. ``SERVE_POD_
+    WORKERS`` (default 3) worker PROCESSES each load the same PR 9
+    AOT artifact (``serving.transport.worker_main`` — zero compiles,
+    ever) and serve the length-prefixed frame protocol; the parent
+    fronts them with a ``PodClientEngine`` facade + one
+    ``SocketTransport`` replica per worker behind the SAME
+    ``FailoverRouter``/``ServingService`` stack every in-process leg
+    used. Mid-stream, under a SCRIPTED ``NetChaosPlan``:
+
+    - one worker is PARTITIONED (its transport blackholes two
+      dispatches — hang, timeout, drop the connection, reconnect),
+    - one worker is SIGKILLed (the transport's ``kill_cb`` delivers a
+      real SIGKILL, then dispatches into the corpse — connection
+      reset, circuit opens, in-flight batch requeues to survivors),
+    - and a ``swap_weights`` version-announce broadcasts to the pod,
+      so post-swap spans carry the NEW agreed model_version whichever
+      surviving worker serves them.
+
+    Abort-grade, like every leg: zero lost accepted requests (every
+    future resolves ok or typed), at least one kill AND one partition
+    actually fired, exactly-once request spans router-side WITH the
+    trace propagated across the wire (each worker streams its
+    ``pod_dispatch`` spans to rotating JSONL; their trace ids must
+    all be batch ids the router sent — the TRACECTX.v1 consumer),
+    zero recompiles on every surviving worker (read back over the
+    wire via ``stats`` frames), and the post-swap version pin."""
+    import signal
+    import subprocess
+
+    from fedamw_tpu.serving import (DeadlineExceeded, FailoverRouter,
+                                    NetChaosPlan, PodClientEngine,
+                                    Replica, ServingEngine,
+                                    ServingService, SocketTransport)
+    from fedamw_tpu.utils.trace import Tracer, read_jsonl
+
+    n_workers = max(2, _env_int("SERVE_POD_WORKERS", 3))
+    n_requests = _env_int("SERVE_POD_REQUESTS", 120)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    warm = ServingEngine.load(ckpt, buckets=buckets)
+    warm.warmup()
+    swap_params = {k: np.asarray(v) for k, v in warm.params.items()}
+    swap_rff = warm.rff
+    if swap_rff is not None:
+        swap_rff = (np.asarray(swap_rff[0]), np.asarray(swap_rff[1]))
+    scratch = tempfile.mkdtemp(prefix="serve_pod_")
+    art_dir = os.path.join(scratch, "artifact")
+    trace_dir = os.path.join(scratch, "worker_trace")
+    os.makedirs(trace_dir, exist_ok=True)
+    procs, logs = [], []
+    try:
+        t0 = time.perf_counter()
+        export_artifact_checked(warm, ckpt, buckets, art_dir)
+        export_s = time.perf_counter() - t0
+
+        # spawn the pod: each worker is a REAL process loading the
+        # artifact and publishing its bound port through a port file
+        # (spawned in parallel — interpreter+jax startup dominates)
+        t0 = time.perf_counter()
+        for i in range(n_workers):
+            port_file = os.path.join(scratch, f"port{i}")
+            code = (
+                "import fedamw_tpu\n"
+                "from fedamw_tpu.serving.transport import worker_main\n"
+                f"worker_main({port_file!r}, artifact_dir={art_dir!r},"
+                f" checkpoint={ckpt!r}, worker_id={i},"
+                f" trace_dir={trace_dir!r})\n")
+            log = open(os.path.join(scratch, f"worker{i}.log"), "w")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", code], cwd=repo,
+                stdout=log, stderr=log))
+        endpoints = []
+        for i in range(n_workers):
+            port_file = os.path.join(scratch, f"port{i}")
+            deadline = time.perf_counter() + 120
+            while not os.path.exists(port_file):
+                if procs[i].poll() is not None or \
+                        time.perf_counter() > deadline:
+                    print(f"# serve_bench aborted: pod worker {i} "
+                          f"never came up (rc={procs[i].poll()}); see "
+                          f"{scratch}/worker{i}.log", file=sys.stderr)
+                    with open(os.path.join(scratch,
+                                           f"worker{i}.log")) as f:
+                        print(f.read()[-2000:], file=sys.stderr)
+                    raise SystemExit(1)
+                time.sleep(0.05)
+            with open(port_file) as f:
+                endpoints.append(("127.0.0.1", int(f.read().strip())))
+        spawn_s = time.perf_counter() - t0
+
+        pod = PodClientEngine(endpoints)
+        # scripted network chaos, deterministic every run: worker 0's
+        # route partitions on its 6th and 9th dispatch (hang, bounded
+        # timeout, reconnect), worker 1 is SIGKILLed at its 8th.
+        # Indices are LOW on purpose, same reasoning as the chaos leg:
+        # the paced stream must actually reach them
+        part_at, kill_at = [5, 8], 7
+        plan = NetChaosPlan.scripted(
+            n_workers, partitions={0: part_at}, kills={1: kill_at},
+            horizon=65536, partition_s=0.2)
+
+        def kill_cb(host):
+            os.kill(procs[host].pid, signal.SIGKILL)
+
+        transports = [
+            SocketTransport(endpoints[i], client=pod, host_index=i,
+                            chaos=plan, kill_cb=kill_cb,
+                            n_hosts=n_workers)
+            for i in range(n_workers)]
+        replicas = [Replica(i, pod, transport=transports[i])
+                    for i in range(n_workers)]
+        tracer = Tracer(max_spans=4 * n_requests + 64)
+        sizes = [1, 4, 8]
+        rng = np.random.RandomState(23)
+        payloads = [rng.randn(s, pod.input_dim).astype(np.float32)
+                    for s in sizes]
+        ok = deadline_n = lost = 0
+        submitted, post_swap = [], []
+        swap_ver = None
+        t0 = time.perf_counter()
+        with FailoverRouter(replicas, policy="round_robin") as router:
+            with ServingService(router, max_wait_ms=max_wait_ms,
+                                max_queue=max(1024, n_requests),
+                                tracer=tracer) as svc:
+                futs = []
+                for i in range(n_requests):
+                    if i == n_requests // 2:
+                        # the version-announce broadcast, mid-stream,
+                        # AFTER the kill fired: only survivors ack,
+                        # and they must agree on the number
+                        swap_ver = router.swap_weights(swap_params,
+                                                       rff=swap_rff)
+                    f = svc.submit(payloads[i % len(payloads)],
+                                   timeout_s=30.0)
+                    submitted.append(f.request_id)
+                    if swap_ver is not None:
+                        post_swap.append(f.request_id)
+                    futs.append(f)
+                    time.sleep(0.0015)
+                for f in futs:
+                    try:
+                        f.result(timeout=60)
+                        ok += 1
+                    except DeadlineExceeded:
+                        deadline_n += 1
+                    except Exception as e:
+                        print(f"# pod stream: request failed "
+                              f"{type(e).__name__}: {e}",
+                              file=sys.stderr)
+                        lost += 1
+                fo = svc.metrics.snapshot(router)["failover"]
+        stream_s = time.perf_counter() - t0
+
+        # evidence, over the wire: per-worker stats frames (the
+        # killed worker reads back dead), per-transport fault counts
+        stats = pod.worker_stats()
+        survivors = [m for m in stats if not m.get("dead")]
+        dead_workers = [m for m in stats if m.get("dead")]
+        faults = {k: sum(t.faults_injected[k] for t in transports)
+                  for k in ("partition", "refuse", "lag", "kill")}
+        reconnects = sum(t.reconnects for t in transports)
+
+        req_spans = [r for r in tracer.records()
+                     if r["name"] == "request"]
+        ids = [r["trace_id"] for r in req_spans]
+        spans_once = (sorted(ids) == sorted(submitted)
+                      and tracer.dropped == 0)
+        post_ids = set(post_swap)
+        post_versions = {r["attrs"].get("model_version")
+                         for r in req_spans if r["trace_id"] in post_ids}
+        swap_ok = bool(post_swap) and post_versions == {swap_ver}
+
+        # the cross-process trace: every worker streamed pod_dispatch
+        # spans under the TRACECTX the router sent — their trace ids
+        # must be batch ids the router-side request spans reference
+        batch_ids = {r["attrs"].get("batch") for r in req_spans}
+        pod_spans = 0
+        alien_ids = 0
+        for part in sorted(os.listdir(trace_dir)):
+            _, spans = read_jsonl(os.path.join(trace_dir, part))
+            for sp in spans:
+                if sp["name"] != "pod_dispatch":
+                    continue
+                pod_spans += 1
+                if sp["trace_id"] not in batch_ids:
+                    alien_ids += 1
+        trace_propagated = pod_spans >= 1 and alien_ids == 0
+
+        section = {
+            "workers": n_workers,
+            "requests": n_requests,
+            "resolved_ok": ok,
+            "deadline_exceeded": deadline_n,
+            "lost": lost,
+            "kills_planned": 1,
+            "kills_fired": faults["kill"],
+            "partitions_planned": len(part_at),
+            "partitions_fired": faults["partition"],
+            "workers_dead": len(dead_workers),
+            "requeues": fo["requeues"],
+            "reconnects": reconnects,
+            "artifact_export_s": round(export_s, 3),
+            "worker_spawn_s": round(spawn_s, 3),
+            "stream_s": round(stream_s, 3),
+            "spans_exactly_once": spans_once,
+            "midstream_swap_version": swap_ver,
+            "swap_acks": pod.last_announce["acks"],
+            "post_swap_requests": len(post_swap),
+            "post_swap_version_ok": swap_ok,
+            "pod_dispatch_spans": pod_spans,
+            "trace_propagated": trace_propagated,
+            "survivor_recompiles": sum(
+                int(m.get("compile_count", 0)) for m in survivors),
+            "survivor_dispatches": sum(
+                int(m.get("dispatches", 0)) for m in survivors),
+            "per_worker": [
+                {k: m.get(k) for k in ("worker", "dispatches",
+                                       "swaps", "compile_count",
+                                       "version", "dead")}
+                for m in stats],
+        }
+        if (lost or not spans_once or faults["kill"] < 1
+                or faults["partition"] < 1 or not dead_workers
+                or section["survivor_recompiles"]
+                or not survivors or not swap_ok
+                or not trace_propagated):
+            # abort-grade, like parity: a lost request across the
+            # wire, a span lost or duplicated, chaos that never
+            # fired, a surviving worker that compiled, a post-swap
+            # span on the wrong version, or a trace id that failed to
+            # cross the hop must not emit green-looking numbers
+            print(f"# serve_bench aborted: pod leg failed "
+                  f"({json.dumps(section)})", file=sys.stderr)
+            raise SystemExit(1)
+        return section
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+        for log in logs:
+            log.close()
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
 def continuous_batching_bench(ckpt, buckets, max_wait_ms):
     """The ISSUE 13 leg: continuous batching over a traffic-learned
     ladder, measured PAIRED against the fixed-drain baseline it
@@ -1613,6 +1879,24 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
     overload_s = time.perf_counter() - t_ov0
     print(f"# {format_overload_report(overload)}", file=sys.stderr)
 
+    # ISSUE 15: the cross-process pod leg — real worker processes
+    # over the frame protocol, one SIGKILLed and one partitioned
+    # mid-stream under scripted network chaos, a version announce
+    # broadcast to the survivors; zero lost accepted requests,
+    # exactly-once spans with the trace propagated across the wire,
+    # and zero recompiles on survivors are abort-grade
+    t_pod0 = time.perf_counter()
+    pod = pod_bench(ckpt, tuple(engine.buckets), max_wait_ms)
+    pod_s = time.perf_counter() - t_pod0
+    print(f"# pod: {pod['workers']} workers, {pod['requests']} "
+          f"requests, {pod['kills_fired']} kill + "
+          f"{pod['partitions_fired']} partitions fired, "
+          f"{pod['requeues']} requeues, {pod['lost']} lost, "
+          f"survivor recompiles {pod['survivor_recompiles']}, "
+          f"swap v{pod['midstream_swap_version']} "
+          f"({pod['swap_acks']} acks), {pod['pod_dispatch_spans']} "
+          f"cross-process spans", file=sys.stderr)
+
     # the zero-recompile pin now spans EVERY stream — untraced, traced,
     # and the rollout leg's swapped versions: tracing must not perturb
     # the shape discipline, and neither may a weight swap
@@ -1653,13 +1937,13 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
 
     artifact = {
         "metric": "serve_bench",
-        # v7: the overload section (elastic fleet + admission control)
-        # joins the v6 continuous_batching, v5 telemetry_overhead, v4
-        # cold_start, v3 chaos, and v2 rollout sections in the
-        # contract — tools/check_bench_schema.py requires each from
-        # its version on (earlier artifacts are grandfathered by
-        # schema version)
-        "schema": "BENCH_SERVE.v7",
+        # v8: the pod section (cross-process serving over the frame
+        # protocol) joins the v7 overload, v6 continuous_batching, v5
+        # telemetry_overhead, v4 cold_start, v3 chaos, and v2 rollout
+        # sections in the contract — tools/check_bench_schema.py
+        # requires each from its version on (earlier artifacts are
+        # grandfathered by schema version)
+        "schema": "BENCH_SERVE.v8",
         "platform": platform,
         "engine": {
             "buckets": list(engine.buckets),
@@ -1679,6 +1963,7 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
                    "telemetry_s": round(telemetry_s, 3),
                    "continuous_batching_s": round(cb_s, 3),
                    "overload_s": round(overload_s, 3),
+                   "pod_s": round(pod_s, 3),
                    # None when BENCH_COMPILE_CACHE is unset (cold by
                    # construction); else dir + entry counts, so a
                    # warm-cache compile_warmup_s can never be read as
@@ -1693,6 +1978,7 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
         "telemetry_overhead": telemetry,
         "continuous_batching": cb,
         "overload": overload,
+        "pod": pod,
         "trace": {
             "request_spans": len(req_spans),
             "unique_request_ids": len(set(ids)),
@@ -1718,9 +2004,27 @@ def _run_bench(engine, setup, X_test_raw, ckpt, platform, iters,
         json.dump(artifact, f, indent=1)
     print(f"# artifact -> {out_path}", file=sys.stderr)
 
-    # the overload line (FIRST of the leg lines — each new leg
-    # prepends, so every existing line position the contract test
-    # pins is unmoved and the headline stays LAST): the elastic
+    # the pod line (FIRST of the leg lines — each new leg prepends,
+    # so every existing line position the contract test pins is
+    # unmoved and the headline stays LAST): the cross-process
+    # evidence — a real SIGKILL and a real partition survived on a
+    # real wire, nothing lost, nothing compiled, the trace intact
+    print(json.dumps({
+        "metric": "serve_pod",
+        "value": pod["requeues"],
+        "unit": "requeues-across-processes",
+        "workers": pod["workers"],
+        "kills_fired": pod["kills_fired"],
+        "partitions_fired": pod["partitions_fired"],
+        "lost": pod["lost"],
+        "survivor_recompiles": pod["survivor_recompiles"],
+        "spans_exactly_once": pod["spans_exactly_once"],
+        "trace_propagated": pod["trace_propagated"],
+        "swap_version": pod["midstream_swap_version"],
+        "platform": platform,
+    }))
+
+    # the overload line: the elastic
     # fleet's whole claim — SLO-good work per replica-second vs the
     # best fixed fleet, interactive protected while batch sheds,
     # nothing lost, nothing compiled

@@ -543,6 +543,67 @@ def test_serve_v7_rejects_overload_drift(tmp_path):
     assert any("scale_ups" in e for e in cbs.validate_file(p))
 
 
+GOOD_POD = {
+    "workers": 3, "requests": 120, "resolved_ok": 118,
+    "deadline_exceeded": 2, "lost": 0,
+    "kills_planned": 1, "kills_fired": 1,
+    "partitions_planned": 2, "partitions_fired": 1,
+    "workers_dead": 1, "requeues": 2, "reconnects": 4,
+    "artifact_export_s": 0.2, "worker_spawn_s": 3.0,
+    "stream_s": 0.5, "spans_exactly_once": True,
+    "midstream_swap_version": 1, "swap_acks": 2,
+    "post_swap_requests": 60, "post_swap_version_ok": True,
+    "pod_dispatch_spans": 22, "trace_propagated": True,
+    "survivor_recompiles": 0, "survivor_dispatches": 15,
+    "per_worker": [],
+}
+
+
+def _serve_art_v8(**extra):
+    art = _serve_art_v7(schema="BENCH_SERVE.v8",
+                        pod=json.loads(json.dumps(GOOD_POD)))
+    art.update(extra)
+    return art
+
+
+def test_serve_v8_requires_pod_section(tmp_path):
+    """From schema v8 on, the cross-process serving leg's 'pod'
+    section is contract; v7 artifacts predate it and stay valid."""
+    assert cbs.validate_file(
+        _write(tmp_path, "BENCH_SERVE_r09.json", _serve_art_v8())) == []
+    art = _serve_art_v8()
+    del art["pod"]
+    errs = cbs.validate_file(_write(tmp_path, "BENCH_SERVE_r09.json",
+                                    art))
+    assert any("'pod' section" in e for e in errs)
+    # v7 stays valid without the section (pre-ISSUE-15 shape)
+    v7 = _serve_art_v7()
+    assert cbs.validate_file(
+        _write(tmp_path, "BENCH_SERVE_r09.json", v7)) == []
+
+
+def test_serve_v8_rejects_pod_drift(tmp_path):
+    # the abort-grade pins, re-checked at the gate: a one-process
+    # "pod", chaos that never fired, a lost request, a broken trace
+    # hop, or a compiled survivor must never land in a committed
+    # artifact
+    for key, bad, needle in (
+            ("workers", 1, "not a pod"),
+            ("requests", 0, "positive"),
+            ("kills_fired", 0, "never killed"),
+            ("partitions_fired", 0, "never partitioned"),
+            ("lost", 2, "lost"),
+            ("spans_exactly_once", False, "spans_exactly_once"),
+            ("trace_propagated", False, "TRACECTX"),
+            ("survivor_recompiles", 3, "never compile")):
+        pod = json.loads(json.dumps(GOOD_POD))
+        pod[key] = bad
+        p = _write(tmp_path, "BENCH_SERVE_r09.json",
+                   _serve_art_v8(pod=pod))
+        assert any(needle in e for e in cbs.validate_file(p)), \
+            f"accepted broken pod {key}={bad!r}"
+
+
 def test_rejects_multichip_ok_rc_disagreement(tmp_path):
     p = _write(tmp_path, "MULTICHIP_r09.json",
                {"n_devices": 8, "rc": 124, "ok": True, "tail": "OK"})

@@ -826,3 +826,85 @@ def test_interactive_protected_under_sustained_overload():
     # the protected class keeps serving while batch is traded away
     assert ok_rate["interactive"] > ok_rate["batch"]
     assert ok_rate["interactive"] >= 0.8
+
+
+# -- per-class deadline defaults (ISSUE 15 satellite) ------------------
+
+class _WedgedEngine:
+    """Engine whose dispatch stalls far past any class deadline —
+    what a class-implied timeout must protect callers from."""
+
+    def __init__(self, stall_s=5.0):
+        self.buckets = (1, 8)
+        self.input_dim = D
+        self.num_classes = C
+        self.version = 0
+        self.compile_count = 0
+        self.stall_s = stall_s
+
+    def predict(self, X, version=None, record_timings=True):
+        time.sleep(self.stall_s)
+        return np.zeros((np.atleast_2d(X).shape[0], C), np.float32)
+
+
+def test_slo_class_owns_a_default_timeout():
+    # explicit wins; unset derives 4x the threshold — the vocabulary
+    # owns the number either way
+    c = SloClass("interactive", threshold_ms=50.0, objective=0.9,
+                 default_timeout_s=0.75)
+    assert c.timeout_s() == 0.75
+    d = SloClass("batch", threshold_ms=500.0, objective=0.9)
+    assert d.timeout_s() == pytest.approx(2.0)
+    with pytest.raises(ValueError, match="default_timeout_s"):
+        SloClass("x", threshold_ms=10.0, default_timeout_s=0.0)
+
+
+def test_class_deadline_applies_without_hand_picked_timeout():
+    """The satellite's whole point: a submit that names its class but
+    no timeout gets the class deadline — observable as a
+    DeadlineExceeded against a wedged engine, where the pre-ISSUE-15
+    behavior would hang the caller for the full stall."""
+    from fedamw_tpu.serving import DeadlineExceeded
+
+    classes = (SloClass("interactive", threshold_ms=50.0,
+                        objective=0.9, default_timeout_s=0.2),)
+    engine = _WedgedEngine(stall_s=1.0)
+    with ServingService(engine, slo_classes=classes) as svc:
+        x = np.zeros((1, D), np.float32)
+        # head request occupies the engine for the full stall...
+        head = svc.submit(x, slo_class="interactive")
+        time.sleep(0.1)  # let the worker dequeue it and wedge
+        # ...so the second ages in the queue past its CLASS deadline
+        # (0.2s) — no timeout_s hand-picked anywhere
+        fut = svc.submit(x, slo_class="interactive")
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=30)
+        assert head.result(timeout=30).shape == (1, C)
+
+
+def test_explicit_timeout_wins_over_class_default():
+    classes = (SloClass("interactive", threshold_ms=50.0,
+                        objective=0.9, default_timeout_s=0.05),)
+    engine = _WedgedEngine(stall_s=0.3)
+    with ServingService(engine, slo_classes=classes) as svc:
+        x = np.zeros((1, D), np.float32)
+        # the caller's explicit, LONGER deadline overrides the tiny
+        # class default: the request survives the stall
+        out = svc.submit(x, slo_class="interactive",
+                         timeout_s=30.0).result(timeout=30)
+        assert out.shape == (1, C)
+
+
+def test_unknown_class_and_no_vocabulary_stay_deadline_free():
+    # outside the vocabulary (and with no vocabulary at all), nothing
+    # changes: no implied deadline, the pre-ISSUE-15 behavior
+    classes = (SloClass("interactive", threshold_ms=50.0,
+                        objective=0.9, default_timeout_s=0.05),)
+    engine = _WedgedEngine(stall_s=0.3)
+    with ServingService(engine, slo_classes=classes) as svc:
+        x = np.zeros((1, D), np.float32)
+        out = svc.submit(x, slo_class="bulk").result(timeout=30)
+        assert out.shape == (1, C)
+    with ServingService(engine) as svc:
+        out = svc.submit(x, slo_class="interactive").result(timeout=30)
+        assert out.shape == (1, C)

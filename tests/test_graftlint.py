@@ -581,6 +581,104 @@ def test_gl004_near_misses_stay_silent(tmp_path):
     assert findings == []
 
 
+def test_gl004_flags_lock_across_blocking_socket(tmp_path):
+    """The ISSUE 15 vocabulary extension: a lock held across socket
+    connect/send/recv stalls every contending thread by a network
+    round-trip — the exact hazard the cross-process transport
+    introduces, and the one its argued exchange-region suppression
+    exists for."""
+    findings, _ = lint_src(tmp_path, """
+        import socket
+        import threading
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def dial(self, addr):
+                with self._lock:
+                    self._sock = socket.create_connection(addr)
+
+            def exchange(self, payload):
+                with self._lock:
+                    self._sock.sendall(payload)
+                    return self._sock.recv(4096)
+
+            def serve(self):
+                with self._lock:
+                    conn, _ = self._listener.accept()
+                return conn
+    """)
+    assert rules_of(findings) == ["GL004"]
+    assert len(findings) == 4
+    msgs = " | ".join(f.message for f in findings)
+    assert "socket connect" in msgs and "socket send" in msgs
+    assert "socket recv" in msgs and "socket accept" in msgs
+
+
+def test_gl004_socket_near_misses_stay_silent(tmp_path):
+    # socket I/O OUTSIDE the lock — the counter-then-exchange shape
+    # the real SocketTransport uses for its backoff state — is the
+    # blessed pattern
+    findings, _ = lint_src(tmp_path, """
+        import socket
+        import threading
+
+        class T:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def dispatch(self, addr, payload):
+                with self._lock:
+                    k = self._dispatches
+                    self._dispatches = k + 1
+                sock = socket.create_connection(addr)
+                sock.sendall(payload)
+                return sock.recv(4096)
+    """)
+    assert findings == []
+
+
+def test_gl003_flags_host_sync_in_transport_serve_loop(tmp_path):
+    """The ISSUE 15 hot-path extension: the worker-side dispatch
+    handler runs once per pod request — a device sync on the hosted
+    engine's result is a per-request stall GL003 must catch."""
+    findings, _ = lint_src(tmp_path, """
+        import numpy as np
+
+        class PodWorker:
+            def _handle_dispatch(self, header, payload):
+                X = self._decode(header, payload)
+                out = self.engine.predict(X)
+                out.block_until_ready()
+                return np.asarray(out).tobytes()
+    """, name="serving/transport.py")
+    assert rules_of(findings) == ["GL003"]
+    assert len(findings) == 2
+
+
+def test_gl003_transport_near_miss_stays_silent(tmp_path):
+    # the REAL handler's shape: frame decode, one engine dispatch,
+    # .tobytes() on the (already-host) result — no converter on the
+    # dispatch result, no explicit sync
+    findings, _ = lint_src(tmp_path, """
+        class PodWorker:
+            def _handle_dispatch(self, header, payload):
+                X = self._decode(header, payload)
+                out = self.engine.predict(X)
+                resp = {"rows": int(out.shape[0])}
+                return resp, out.tobytes()
+
+        class SocketTransport:
+            def dispatch(self, X):
+                with self._lock:
+                    k = self._dispatches
+                    self._dispatches = k + 1
+                return self._exchange(X, k)
+    """, name="serving/transport.py")
+    assert findings == []
+
+
 # -- GL005: impure traced code ----------------------------------------
 
 def test_gl005_flags_host_rng_and_wallclock_in_traced_code(tmp_path):
@@ -939,8 +1037,17 @@ def test_package_gate_zero_unsuppressed_findings():
     # the justification in the diff). 9th (ISSUE 12): artifacts.py's
     # _EXPORT_LOCK acquire/release region — newly VISIBLE to GL004's
     # acquire-spelling analysis, and argued (a process-wide export
-    # serializes blocking work by design; never the serving hot path)
-    assert len(suppressed) == 9
+    # serializes blocking work by design; never the serving hot path).
+    # 10th + 11th (ISSUE 15): transport.py's SocketTransport exchange
+    # region — the I/O lock deliberately held across the socket
+    # round-trip (one in-flight exchange per connection IS the frame
+    # protocol; interleaved frames from a second thread would corrupt
+    # both) — and PodClientEngine's swap-announce region (the whole
+    # pick->broadcast->commit is one critical section: two
+    # interleaved announces would serve different weights under one
+    # agreed version number); both flagged by GL004's new
+    # blocking-socket vocabulary and argued at their acquire lines
+    assert len(suppressed) == 11
 
 
 # -- mutation checks: the gate is live --------------------------------

@@ -31,8 +31,13 @@ load: ``queue_depth_peak < requests``); the ISSUE 14 overload leg —
 the burn-rate admission controller + autoscaled fleet against every
 fixed-N fleet under one seeded flash crowd in a v7 ``overload``
 section, the beat / interactive-protection / zero-lost /
-zero-recompile / exactly-once pins all held; and the strict-backend guard
-— BENCH_STRICT_TPU
+zero-recompile / exactly-once pins all held; the ISSUE 15 pod leg —
+a multi-process worker pod over the socket frame protocol, one
+worker SIGKILLed and one partitioned mid-stream under scripted
+network chaos, a mid-stream version announce, zero lost accepted
+requests / exactly-once spans / trace-propagated-across-the-wire /
+zero survivor recompiles in a v8 ``pod`` section; and the
+strict-backend guard — BENCH_STRICT_TPU
 must abort rc=1 on a leaked CPU backend BEFORE measuring anything,
 exactly like bench.py, so a CPU capture can never be harvested as TPU
 evidence.
@@ -163,8 +168,8 @@ def test_serve_bench_emits_driver_contract_json(tmp_path):
     assert cbl["spans_exactly_once"] is True
     assert cbl["ladder"]  # a non-empty learned rung list
 
-    # ISSUE 14 pins — the overload line prints first of the leg lines
-    # (all later positions unmoved, headline still LAST): the elastic
+    # ISSUE 14 pins — the overload line (position unmoved, headline
+    # still LAST): the elastic
     # fleet beat every fixed fleet on SLO-good work per
     # replica-second, interactive held while batch shed, the
     # autoscaler actually scaled, nothing lost, nothing compiled
@@ -180,10 +185,28 @@ def test_serve_bench_emits_driver_contract_json(tmp_path):
     assert ovl["recompiles_during_overload"] == 0
     assert ovl["spans_exactly_once"] is True
 
+    # ISSUE 15 pins — the pod line prints first of the leg lines (all
+    # later positions unmoved, headline still LAST): the pod survived
+    # a real SIGKILL and a real partition on a real wire, requeued
+    # the in-flight batches, lost nothing, compiled nothing, and the
+    # trace crossed the hop intact
+    pod_lines = [l for l in lines if l["metric"] == "serve_pod"]
+    assert len(pod_lines) == 1 and pod_lines[0] == lines[-9]
+    pl = pod_lines[0]
+    assert pl["workers"] == 3
+    assert pl["kills_fired"] >= 1
+    assert pl["partitions_fired"] >= 1
+    assert pl["value"] >= 1  # requeues across processes
+    assert pl["lost"] == 0
+    assert pl["survivor_recompiles"] == 0
+    assert pl["spans_exactly_once"] is True
+    assert pl["trace_propagated"] is True
+    assert isinstance(pl["swap_version"], int)
+
     # the artifact mirrors the lines and carries the parity verdict
     with open(out_path) as f:
         art = json.load(f)
-    assert art["schema"] == "BENCH_SERVE.v7"
+    assert art["schema"] == "BENCH_SERVE.v8"
     assert art["recompiles_after_warmup"] == 0
     assert len(art["bucket_latency"]) >= 3
     assert art["parity"]["match"] is True
@@ -385,6 +408,35 @@ def test_serve_bench_emits_driver_contract_json(tmp_path):
     assert ov["classes"]["interactive"]["objective"] <= \
         auto["attainment"]["interactive"]
     assert art["phases"]["overload_s"] >= 0
+
+    # the pod section: the v8 contract
+    # (tools/check_bench_schema.py gates it) — the cross-process
+    # evidence in full: every accepted request resolved typed, the
+    # scripted chaos actually fired against real processes, the swap
+    # announce reached the survivors under one agreed version, and
+    # the worker-side spans joined the router's traces
+    pod = art["pod"]
+    assert pod["workers"] == 3
+    assert pod["requests"] == 120
+    assert pod["resolved_ok"] + pod["deadline_exceeded"] == \
+        pod["requests"]
+    assert pod["lost"] == 0
+    assert pod["kills_fired"] == pod["kills_planned"] == 1
+    assert pod["workers_dead"] == 1
+    assert pod["partitions_fired"] >= 1
+    assert pod["requeues"] >= 1
+    assert pod["spans_exactly_once"] is True
+    assert pod["trace_propagated"] is True
+    assert pod["pod_dispatch_spans"] >= 1
+    assert pod["survivor_recompiles"] == 0
+    assert pod["survivor_dispatches"] >= 1
+    assert pod["post_swap_requests"] >= 1
+    assert pod["post_swap_version_ok"] is True
+    assert pod["swap_acks"] >= 2
+    # one per_worker row per spawned process; exactly one read dead
+    assert len(pod["per_worker"]) == 3
+    assert sum(1 for m in pod["per_worker"] if m.get("dead")) == 1
+    assert art["phases"]["pod_s"] >= 0
 
     # SERVE_TRACE exported the traced leg's spans as readable JSONL
     from fedamw_tpu.utils.trace import read_jsonl

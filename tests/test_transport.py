@@ -1,0 +1,861 @@
+"""Cross-process dispatch transport (ISSUE 15).
+
+Load-bearing contracts:
+
+- **Frame protocol**: round-trips exactly; truncated, oversized, and
+  garbage frames are rejected LOUDLY (typed ``FrameError`` — a
+  permanent ``ValueError`` the retry machinery refuses to retry),
+  never silently skipped or length-interpreted.
+- **InProcess equivalence**: a ``Replica`` built without a transport
+  dispatches byte-identically to a direct ``engine.predict`` — the
+  extracted seam changes NOTHING in-process (every pre-existing
+  replica/chaos/control test is the wider pin; these are the direct
+  ones).
+- **Deadline budget crosses the hop**: the dispatch frame carries the
+  REMAINING budget (shrunk by time already spent), socket timeouts
+  derive from it, an exhausted budget fails before any I/O, and the
+  worker refuses expired work.
+- **TRACECTX propagation**: the worker's ``pod_dispatch`` span lands
+  under the exact trace id + parent the client injected —
+  ``utils.trace.inject_context``'s consumer, end-to-end over a real
+  socket.
+- **NetChaosSpec determinism**: same spec ⇒ bitwise-identical
+  schedule (the ``ChaosSpec``/``LoadSpec`` contract on the network
+  axis); the grammar parses and validates loudly.
+- **SIGKILL-mid-batch requeue**: a worker PROCESS killed mid-dispatch
+  fails transiently; the router requeues the in-flight batch against
+  a survivor within the original request deadline — nothing lost.
+- **Worker version agreement**: one ``swap_weights`` announce lands
+  every pod worker on the SAME version number; post-swap dispatches
+  report it from the wire.
+"""
+
+import multiprocessing
+import os
+import signal
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedamw_tpu.serving import (FailoverRouter, FrameError,
+                                InProcessTransport, NetChaosPlan,
+                                NetChaosSpec, PodClientEngine,
+                                PodWorker, Replica, ServingEngine,
+                                ServingService, SocketTransport,
+                                TransportError, TransportRefused,
+                                TransportTimeout, pack_weights,
+                                resolve_net_chaos, unpack_weights)
+from fedamw_tpu.serving.chaos import (NET_CLEAN, NET_LAG,
+                                      NET_PARTITION, NET_REFUSE)
+from fedamw_tpu.serving.transport import (FRAME_MAGIC, pack_batch,
+                                          read_frame, unpack_batch,
+                                          write_frame)
+from fedamw_tpu.utils.trace import Tracer, inject_context
+
+pytestmark = pytest.mark.transport
+
+D, C = 16, 3
+
+
+class StubEngine:
+    """Numpy-only engine for socket tests: deterministic logits, the
+    metadata surface a PodWorker/facade needs, optional per-dispatch
+    sleep (the slow worker the SIGKILL and timeout tests need)."""
+
+    def __init__(self, sleep_s=0.0, seed=1, buckets=(1, 8, 32)):
+        self.W = np.random.RandomState(seed).randn(C, D).astype(
+            np.float32)
+        self.buckets = tuple(buckets)
+        self.input_dim = D
+        self.num_classes = C
+        self.version = 0
+        self.compile_count = 0
+        self.sleep_s = sleep_s
+
+    def predict(self, X, version=None, record_timings=True):
+        if self.sleep_s:
+            time.sleep(self.sleep_s)
+        return np.asarray(X, np.float32) @ self.W.T
+
+    def swap_weights(self, params, rff=None, version=None):
+        self.W = np.asarray(params["w"], np.float32)
+        self.version = int(version)
+        return self.version
+
+
+def make_engine(buckets=(1, 8, 32)):
+    rng = np.random.RandomState(1)
+    e = ServingEngine({"w": rng.randn(C, D).astype(np.float32)},
+                      buckets=buckets)
+    e.warmup()
+    return e
+
+
+def rows(n, seed=5):
+    return np.random.RandomState(seed).randn(n, D).astype(np.float32)
+
+
+# -- frame protocol ----------------------------------------------------
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def test_frame_round_trip_header_and_payload():
+    a, b = _pair()
+    try:
+        X = rows(4)
+        hdr, payload = pack_batch(X)
+        hdr["kind"] = "dispatch"
+        write_frame(a, hdr, payload)
+        got, body = read_frame(b)
+        assert got["kind"] == "dispatch"
+        back = unpack_batch(got, body)
+        assert np.array_equal(back, X)
+        assert back.dtype == X.dtype
+        # empty-payload frames round-trip too (control frames)
+        write_frame(a, {"kind": "ping"})
+        got2, body2 = read_frame(b)
+        assert got2["kind"] == "ping" and body2 == b""
+    finally:
+        a.close()
+        b.close()
+
+
+def test_truncated_frame_rejected_loudly():
+    a, b = _pair()
+    try:
+        X = rows(4)
+        hdr, payload = pack_batch(X)
+        hdr["kind"] = "dispatch"
+        # capture the wire bytes, then replay a truncated prefix of
+        # them: the reader must name the truncation, typed
+        cap_a, cap_b = _pair()
+        write_frame(cap_a, hdr, payload)
+        cap_a.shutdown(socket.SHUT_WR)
+        wire = b""
+        while True:
+            chunk = cap_b.recv(1 << 20)
+            if not chunk:
+                break
+            wire += chunk
+        cap_a.close()
+        cap_b.close()
+        a.sendall(wire[: len(wire) - 7])
+        a.shutdown(socket.SHUT_WR)
+        with pytest.raises(FrameError, match="truncated"):
+            read_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_garbage_magic_rejected_loudly():
+    a, b = _pair()
+    try:
+        a.sendall(b"GET / HTTP/1.1\r\n\r\n" + b"\x00" * 16)
+        with pytest.raises(FrameError, match="magic"):
+            read_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_oversized_frame_rejected_both_sides():
+    a, b = _pair()
+    try:
+        # sender-side: the bound trips in the CALLER's stack
+        with pytest.raises(FrameError, match="bound"):
+            write_frame(a, {"kind": "dispatch"}, b"x" * 2048,
+                        max_frame_bytes=1024)
+        # receiver-side: a hostile/corrupt length prefix must not
+        # allocate; it must raise before reading the body
+        import struct
+        a.sendall(struct.pack("!4sII", FRAME_MAGIC, 10, 1 << 30))
+        with pytest.raises(FrameError, match="bound"):
+            read_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_clean_eof_is_transient_not_frame_error():
+    # a peer closing BETWEEN frames is ordinary worker death — the
+    # transient family, which the failover machinery retries
+    a, b = _pair()
+    a.close()
+    try:
+        with pytest.raises(TransportError):
+            read_frame(b)
+    finally:
+        b.close()
+
+
+def test_unpack_batch_size_disagreement_is_loud():
+    hdr, payload = pack_batch(rows(4))
+    bad = dict(hdr, rows=5)
+    with pytest.raises(FrameError, match="disagrees"):
+        unpack_batch(bad, payload)
+
+
+def test_weights_pack_round_trip():
+    params = {"w": rows(3), "b": np.arange(3, dtype=np.float32)}
+    rff = (rows(2, seed=9), np.arange(D, dtype=np.float32))
+    p2, r2 = unpack_weights(pack_weights(params, rff))
+    assert set(p2) == {"w", "b"}
+    assert np.array_equal(p2["w"], params["w"])
+    assert np.array_equal(r2[0], rff[0])
+    p3, r3 = unpack_weights(pack_weights(params))
+    assert r3 is None and np.array_equal(p3["b"], params["b"])
+    with pytest.raises(FrameError):
+        unpack_weights(b"not an npz")
+
+
+# -- InProcessTransport equivalence -----------------------------------
+
+def test_replica_default_transport_is_in_process_and_equivalent():
+    engine = make_engine()
+    rep = Replica(0, engine)
+    assert isinstance(rep.transport, InProcessTransport)
+    X = rows(6)
+    assert np.array_equal(rep.predict(X), engine.predict(X))
+    # deadline/trace_ctx are accepted and inert in-process
+    out = rep.predict(X, deadline=time.perf_counter() + 10,
+                      trace_ctx=inject_context("req-1"))
+    assert np.array_equal(out, engine.predict(X))
+
+
+def test_in_process_transport_dispatch_matches_engine_bitwise():
+    engine = make_engine()
+    t = InProcessTransport(engine)
+    X = rows(5)
+    assert np.array_equal(t.dispatch(X), engine.predict(X))
+    # the timing slot behaves exactly as a direct call: dispatch with
+    # record_timings=True leaves the split for the single consumer
+    t.dispatch(X, record_timings=True)
+    timing = engine.pop_timings()
+    assert timing is not None and timing["version"] == 0
+
+
+def test_router_over_explicit_in_process_transports_unchanged():
+    engine = make_engine()
+    reps = [Replica(i, engine,
+                    transport=InProcessTransport(engine))
+            for i in range(2)]
+    router = FailoverRouter(reps, policy="round_robin")
+    X = rows(4)
+    assert np.array_equal(router.predict(X), engine.predict(X))
+    assert engine.compile_count == len(engine.buckets)
+
+
+# -- socket round trip -------------------------------------------------
+
+def test_socket_dispatch_parity_with_direct_call():
+    engine = make_engine()
+    with PodWorker(engine) as w:
+        with SocketTransport(("127.0.0.1", w.port)) as t:
+            for n in (1, 3, 8):
+                X = rows(n, seed=n)
+                assert np.allclose(t.dispatch(X), engine.predict(X),
+                                   atol=0)
+            assert t.dispatches == 3
+    assert w.dispatches == 3 and w.frame_errors == 0
+
+
+def test_socket_dispatch_version_pin_rides_the_wire():
+    engine = make_engine()
+    engine.install_weights(7, {"w": rows(C, seed=3)})
+    pod = _facade_for(engine)
+    with PodWorker(engine) as w:
+        pod.endpoints = [("127.0.0.1", w.port)]
+        with SocketTransport(("127.0.0.1", w.port), client=pod) as t:
+            t.dispatch(rows(2), version=7)
+            timing = pod.pop_timings()
+    assert timing["version"] == 7
+    # single-consumer slot: popped means gone
+    assert pod.pop_timings() is None
+
+
+def _facade_for(engine):
+    """A PodClientEngine built without a handshake (unit tests that
+    only need the timing slot / metadata surface)."""
+    pod = PodClientEngine.__new__(PodClientEngine)
+    pod.endpoints = []
+    pod.connect_timeout_s = 5.0
+    pod.max_frame_bytes = 1 << 26
+    pod._timings = None
+    pod.buckets = tuple(engine.buckets)
+    pod.input_dim = engine.input_dim
+    pod.num_classes = engine.num_classes
+    pod._version = int(getattr(engine, "version", 0))
+    pod._vlock = threading.Lock()
+    pod._swap_lock = threading.Lock()
+    return pod
+
+
+def test_worker_rejects_garbage_and_keeps_serving():
+    engine = StubEngine()
+    with PodWorker(engine) as w:
+        # a garbage connection gets a loud typed error frame back and
+        # is dropped...
+        with socket.create_connection(("127.0.0.1", w.port),
+                                      timeout=5) as s:
+            s.settimeout(5.0)
+            s.sendall(b"NOT A FRAME AT ALL PADPADPAD")
+            resp, _ = read_frame(s)
+            assert resp["kind"] == "error"
+            assert resp["transient"] is False
+            assert "magic" in resp["error"]
+        # ...and the worker keeps serving real clients afterwards
+        with SocketTransport(("127.0.0.1", w.port)) as t:
+            out = t.dispatch(rows(2))
+            assert out.shape == (2, C)
+    assert w.frame_errors == 1
+
+
+def test_transport_refused_and_reconnect_backoff():
+    # nothing listening: connect refused, typed transient; the second
+    # failure lands inside the backoff window and fast-fails without
+    # touching the wire
+    with socket.socket() as probe:
+        probe.bind(("127.0.0.1", 0))
+        dead_port = probe.getsockname()[1]
+    t = SocketTransport(("127.0.0.1", dead_port), backoff_ms=200.0)
+    with pytest.raises(TransportRefused, match="connect"):
+        t.dispatch(rows(1))
+    t0 = time.perf_counter()
+    with pytest.raises(TransportRefused, match="backoff"):
+        t.dispatch(rows(1))
+    assert time.perf_counter() - t0 < 0.1  # fast-fail, no connect wait
+    assert t.stats()["connect_failures"] == 1
+
+
+# -- deadline budget across the hop -----------------------------------
+
+class _HeaderSpy(PodWorker):
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.headers = []
+
+    def _handle_dispatch(self, header, payload):
+        self.headers.append(dict(header))
+        return super()._handle_dispatch(header, payload)
+
+
+def test_budget_shrinks_across_the_hop():
+    engine = StubEngine()
+    with _HeaderSpy(engine) as w:
+        with SocketTransport(("127.0.0.1", w.port)) as t:
+            deadline = time.perf_counter() + 0.8
+            time.sleep(0.25)  # burn budget BEFORE dispatching
+            t.dispatch(rows(2), deadline=deadline)
+    (hdr,) = w.headers
+    # the frame carried what REMAINED, not the original allowance
+    assert 0.0 < hdr["budget_s"] <= 0.56
+    # and a deadline-free dispatch carries none
+    with _HeaderSpy(engine) as w2:
+        with SocketTransport(("127.0.0.1", w2.port)) as t2:
+            t2.dispatch(rows(2))
+    assert w2.headers[0]["budget_s"] is None
+
+
+def test_exhausted_budget_fails_before_any_io():
+    engine = StubEngine()
+    with PodWorker(engine) as w:
+        with SocketTransport(("127.0.0.1", w.port)) as t:
+            with pytest.raises(TransportTimeout, match="exhausted"):
+                t.dispatch(rows(1),
+                           deadline=time.perf_counter() - 0.01)
+    assert w.dispatches == 0  # nothing crossed the wire
+
+
+def test_read_timeout_derived_from_deadline():
+    # a wedged worker (slow predict) against a tight budget: the read
+    # times out at ~the budget, not at the 10s default io timeout
+    engine = StubEngine(sleep_s=1.5)
+    with PodWorker(engine) as w:
+        with SocketTransport(("127.0.0.1", w.port)) as t:
+            t0 = time.perf_counter()
+            with pytest.raises(TransportTimeout):
+                t.dispatch(rows(1),
+                           deadline=time.perf_counter() + 0.3)
+            assert time.perf_counter() - t0 < 1.0
+
+
+def test_worker_refuses_expired_budget():
+    # the worker-side half of the deadline contract: a frame whose
+    # budget reads exhausted is refused transiently, never dispatched
+    engine = StubEngine()
+    with PodWorker(engine) as w:
+        with socket.create_connection(("127.0.0.1", w.port),
+                                      timeout=5) as s:
+            s.settimeout(5.0)
+            hdr, payload = pack_batch(rows(1))
+            hdr.update(kind="dispatch", version=None, budget_s=-0.1)
+            write_frame(s, hdr, payload)
+            resp, _ = read_frame(s)
+    assert resp["kind"] == "error" and resp["transient"] is True
+    assert "budget" in resp["error"]
+    assert w.dispatches == 0
+
+
+# -- TRACECTX propagation ---------------------------------------------
+
+def test_tracectx_propagates_end_to_end():
+    engine = StubEngine()
+    worker_tracer = Tracer()
+    with PodWorker(engine, tracer=worker_tracer) as w:
+        with SocketTransport(("127.0.0.1", w.port)) as t:
+            t.dispatch(rows(3),
+                       trace_ctx=inject_context("req-77", "s-5"))
+            t.dispatch(rows(1))  # no context: no orphan span either
+    spans = [r for r in worker_tracer.records()
+             if r["name"] == "pod_dispatch"]
+    assert len(spans) == 1
+    (sp,) = spans
+    assert sp["trace_id"] == "req-77"
+    assert sp["parent_id"] == "s-5"
+    assert sp["attrs"]["rows"] == 3
+    assert sp["attrs"]["model_version"] == 0
+
+
+def test_malformed_tracectx_is_loud_not_silent():
+    # a dropped/garbled carrier must surface as a loud error, not a
+    # silently-orphaned span tree (the extract_context contract,
+    # enforced across the wire)
+    engine = StubEngine()
+    worker_tracer = Tracer()
+    with PodWorker(engine, tracer=worker_tracer) as w:
+        with SocketTransport(("127.0.0.1", w.port)) as t:
+            with pytest.raises(RuntimeError, match="trace-context"):
+                t.dispatch(rows(1), trace_ctx="TRACECTX.v9;;;;")
+
+
+def test_service_injects_batch_context_over_the_pod(tmp_path):
+    """End to end through the full stack: ServingService detects the
+    router's trace_ctx capability, sends the batch id as the carrier,
+    and the worker's spans join exactly those traces — request spans
+    still landing exactly once, router-side."""
+    engines = [StubEngine(seed=1), StubEngine(seed=1)]
+    workers = [PodWorker(e, worker_id=i).start()
+               for i, e in enumerate(engines)]
+    worker_tracers = [Tracer(), Tracer()]
+    for w, tr in zip(workers, worker_tracers):
+        w.tracer = tr
+    try:
+        eps = [("127.0.0.1", w.port) for w in workers]
+        pod = PodClientEngine(eps)
+        reps = [Replica(i, pod, transport=SocketTransport(
+            eps[i], client=pod, host_index=i))
+            for i in range(2)]
+        tracer = Tracer()
+        with FailoverRouter(reps, policy="round_robin") as router:
+            with ServingService(router, tracer=tracer) as svc:
+                futs = [svc.submit(rows(2, seed=i), timeout_s=30.0)
+                        for i in range(10)]
+                for f in futs:
+                    f.result(timeout=30)
+        req_spans = [r for r in tracer.records()
+                     if r["name"] == "request"]
+        ids = [r["trace_id"] for r in req_spans]
+        assert sorted(ids) == sorted(f.request_id for f in futs)
+        batch_ids = {r["attrs"]["batch"] for r in req_spans}
+        pod_spans = [r for tr in worker_tracers for r in tr.records()
+                     if r["name"] == "pod_dispatch"]
+        assert pod_spans
+        assert {r["trace_id"] for r in pod_spans} <= batch_ids
+    finally:
+        for w in workers:
+            w.stop()
+
+
+# -- NetChaosSpec / NetChaosPlan --------------------------------------
+
+def test_net_chaos_spec_parse_full_grammar():
+    s = NetChaosSpec.parse(
+        "partition=0.02:250,refuse=0.05,lag=0.1:20,kill_host=1@12,"
+        "kill_host=0@3,seed=7")
+    assert (s.partition, s.partition_s) == (0.02, 0.25)
+    assert (s.refuse, s.lag, s.lag_s, s.seed) == (0.05, 0.1, 0.02, 7)
+    assert dict(s.kill_host) == {1: 12, 0: 3}
+    # bare rates keep the shape defaults; empty spec is clean
+    s2 = NetChaosSpec.parse("partition=0.1,lag=0.2")
+    assert s2.partition_s == 0.25 and s2.lag_s == 0.02
+    assert NetChaosSpec.parse("") == NetChaosSpec()
+
+
+@pytest.mark.parametrize("bad, match", [
+    ("boom=1", "unknown net chaos spec key"),
+    ("partition", "not key=value"),
+    ("partition=lots", "partition=lots"),
+    ("refuse=1.5", r"must be in \[0, 1\]"),
+    ("partition=0.6,refuse=0.6", "sum to <= 1"),
+    ("kill_host=3", "HOST@DISPATCH"),
+    ("kill_host=1@2,kill_host=1@5", "dies once"),
+])
+def test_net_chaos_spec_parse_rejects(bad, match):
+    with pytest.raises(ValueError, match=match):
+        NetChaosSpec.parse(bad)
+
+
+def test_net_chaos_same_seed_bitwise_same_schedule():
+    spec = NetChaosSpec.parse(
+        "partition=0.05:100,refuse=0.1,lag=0.2:10,kill_host=2@8,"
+        "seed=23")
+    p1 = NetChaosPlan.build(spec, 4, horizon=512)
+    p2 = NetChaosPlan.build(spec, 4, horizon=512)
+    assert np.array_equal(p1.roles, p2.roles)
+    assert p1.kills == p2.kills == {2: 8}
+    assert p1.counts() == p2.counts()
+    # a different seed is a different schedule
+    p3 = NetChaosPlan.build(
+        NetChaosSpec.parse("partition=0.05:100,refuse=0.1,lag=0.2:10,"
+                           "seed=24"), 4, horizon=512)
+    assert not np.array_equal(p1.roles, p3.roles)
+    # roles are mutually exclusive per cell, rates roughly honored
+    total = p1.roles.size
+    assert 0 < p1.counts()["partition"] < 0.15 * total
+    assert 0 < p1.counts()["refuse"] < 0.2 * total
+
+
+def test_net_chaos_scripted_and_role_lookup():
+    plan = NetChaosPlan.scripted(3, partitions={0: [2, 5]},
+                                 refuses={1: [0]}, lags={2: [1]},
+                                 kills={1: 4}, horizon=16)
+    assert plan.role(0, 2) == NET_PARTITION
+    assert plan.role(1, 0) == NET_REFUSE
+    assert plan.role(2, 1) == NET_LAG
+    assert plan.role(0, 3) == NET_CLEAN
+    assert plan.role(0, 99) == NET_CLEAN  # past horizon: clean
+    assert plan.kill_at(1) == 4 and plan.kill_at(0) is None
+    with pytest.raises(ValueError, match="two roles"):
+        NetChaosPlan.scripted(2, partitions={0: [1]},
+                              refuses={0: [1]})
+    with pytest.raises(ValueError, match="out of range"):
+        NetChaosPlan.scripted(2, kills={5: 1})
+
+
+def test_resolve_net_chaos_surface():
+    assert resolve_net_chaos(None, 3) is None
+    p = resolve_net_chaos("refuse=0.5,seed=1", 3)
+    assert isinstance(p, NetChaosPlan) and p.n_hosts == 3
+    assert resolve_net_chaos(p, 2) is p  # covers 2 hosts: fine
+    with pytest.raises(ValueError, match="rebuild"):
+        resolve_net_chaos(NetChaosPlan.build(NetChaosSpec(), 1), 3)
+    with pytest.raises(TypeError):
+        resolve_net_chaos(42, 3)
+
+
+def test_chaos_injection_at_the_transport():
+    engine = StubEngine()
+    with PodWorker(engine) as w:
+        plan = NetChaosPlan.scripted(
+            1, refuses={0: [0]}, partitions={0: [1]}, lags={0: [2]},
+            horizon=64, partition_s=0.15, lag_s=0.05)
+        with SocketTransport(("127.0.0.1", w.port), chaos=plan,
+                             host_index=0, n_hosts=1) as t:
+            with pytest.raises(TransportRefused, match="net-chaos"):
+                t.dispatch(rows(1))
+            t0 = time.perf_counter()
+            with pytest.raises(TransportTimeout, match="partition"):
+                t.dispatch(rows(1))
+            stall = time.perf_counter() - t0
+            assert 0.1 <= stall < 1.0
+            t0 = time.perf_counter()
+            out = t.dispatch(rows(2))  # dispatch 2: lag, then serves
+            assert time.perf_counter() - t0 >= 0.05
+            assert out.shape == (2, C)
+            assert t.faults_injected == {"partition": 1, "refuse": 1,
+                                         "lag": 1, "kill": 0}
+
+
+def test_partition_stall_bounded_by_budget():
+    plan = NetChaosPlan.scripted(1, partitions={0: [0]}, horizon=8,
+                                 partition_s=5.0)
+    with SocketTransport(("127.0.0.1", 1), chaos=plan, host_index=0,
+                         n_hosts=1) as t:
+        t0 = time.perf_counter()
+        with pytest.raises(TransportTimeout, match="partition"):
+            t.dispatch(rows(1),
+                       deadline=time.perf_counter() + 0.1)
+        assert time.perf_counter() - t0 < 1.0
+
+
+# -- SIGKILL mid-batch -------------------------------------------------
+
+def _slow_worker_proc(port_file: str) -> None:
+    """Forked child: a pod worker whose predict stalls long enough
+    for the parent to SIGKILL it mid-dispatch."""
+    engine = StubEngine(sleep_s=5.0)
+    worker = PodWorker(engine)
+    with open(port_file + ".tmp", "w") as f:
+        f.write(f"{worker.port}\n")
+    os.replace(port_file + ".tmp", port_file)
+    worker.start()
+    time.sleep(60)
+
+
+@pytest.mark.skipif(not hasattr(os, "fork"), reason="needs fork")
+def test_sigkill_mid_batch_requeues_within_deadline(tmp_path):
+    """THE pod failure mode: the worker process dies BY SIGKILL while
+    a batch is in flight on its socket. The transport fails
+    transiently (reset/EOF), the router's circuit counts it and the
+    in-flight batch requeues against the surviving replica — within
+    the original request deadline, nothing lost, zero recompiles."""
+    port_file = str(tmp_path / "port")
+    ctx = multiprocessing.get_context("fork")
+    proc = ctx.Process(target=_slow_worker_proc, args=(port_file,),
+                       daemon=True)
+    proc.start()
+    deadline = time.perf_counter() + 30
+    while not os.path.exists(port_file):
+        assert time.perf_counter() < deadline, "worker never came up"
+        time.sleep(0.02)
+    with open(port_file) as f:
+        port = int(f.read().strip())
+    engine = StubEngine(seed=1)  # the survivor's (identical) weights
+    victim = Replica(0, engine, transport=SocketTransport(
+        ("127.0.0.1", port), io_timeout_s=20.0))
+    survivor = Replica(1, engine)  # in-process: always healthy
+    router = FailoverRouter([victim, survivor], policy="round_robin")
+    X = rows(4)
+
+    def kill_soon():
+        time.sleep(0.3)  # let the dispatch get in flight first
+        os.kill(proc.pid, signal.SIGKILL)
+
+    killer = threading.Thread(target=kill_soon, daemon=True)
+    killer.start()
+    t0 = time.perf_counter()
+    out = router.predict(X, deadline=time.perf_counter() + 10.0)
+    took = time.perf_counter() - t0
+    killer.join()
+    proc.join(timeout=10)
+    # the batch was answered by the survivor, within the deadline
+    assert np.array_equal(out, engine.predict(X))
+    assert took < 10.0
+    stats = router.replica_stats()
+    assert stats["requeues"] >= 1
+    assert stats["replicas"]["0"]["failed"] >= 1
+    assert stats["replicas"]["1"]["ok"] == 1
+    # and the victim's NEXT dispatch fails fast (refused/reset), so
+    # the circuit keeps counting toward open — no hang, no zombie
+    with pytest.raises((TransportError, FrameError)):
+        victim.transport.dispatch(rows(1))
+
+
+# -- worker version agreement -----------------------------------------
+
+def test_swap_announce_lands_every_worker_on_one_version():
+    engines = [StubEngine(seed=1), StubEngine(seed=1)]
+    workers = [PodWorker(e, worker_id=i).start()
+               for i, e in enumerate(engines)]
+    try:
+        eps = [("127.0.0.1", w.port) for w in workers]
+        pod = PodClientEngine(eps)
+        new_w = rows(C, seed=42)
+        v = pod.swap_weights({"w": new_w})
+        assert v == 1
+        assert pod.version == 1
+        assert [e.version for e in engines] == [1, 1]
+        assert all(np.array_equal(e.W, new_w) for e in engines)
+        assert pod.last_announce["acks"] == 2
+        # post-swap dispatches report the agreed version off the wire
+        with SocketTransport(eps[0], client=pod) as t:
+            t.dispatch(rows(1))
+            assert pod.pop_timings()["version"] == 1
+    finally:
+        for w in workers:
+            w.stop()
+
+
+def test_swap_announce_with_dead_worker_acks_survivors():
+    engine = StubEngine(seed=1)
+    with PodWorker(engine) as w:
+        with socket.socket() as probe:
+            probe.bind(("127.0.0.1", 0))
+            dead_port = probe.getsockname()[1]
+        pod = PodClientEngine([("127.0.0.1", w.port),
+                               ("127.0.0.1", dead_port)])
+        v = pod.swap_weights({"w": rows(C, seed=9)})
+        assert v == 1 and pod.last_announce["acks"] == 1
+        assert len(pod.last_announce["failures"]) == 1
+        assert engine.version == 1
+        # stats surface the death the announce skipped
+        stats = pod.worker_stats()
+        assert [bool(m.get("dead")) for m in stats] == [False, True]
+    # every endpoint dead: the announce must FAIL, and the client's
+    # notion of live must not advance
+    pod2 = PodClientEngine.__new__(PodClientEngine)
+    pod2.endpoints = [("127.0.0.1", dead_port)]
+    pod2.connect_timeout_s = 1.0
+    pod2.max_frame_bytes = 1 << 20
+    pod2._timings = None
+    pod2._version = 1
+    pod2._vlock = threading.Lock()
+    pod2._swap_lock = threading.Lock()
+    with pytest.raises(TransportError, match="no worker"):
+        pod2.swap_weights({"w": rows(C)})
+    assert pod2.version == 1
+
+
+def test_real_engine_pod_swap_and_service_end_to_end():
+    """The full stack over real engines: two workers each hosting
+    their OWN ServingEngine (separate processes in production — the
+    unit here shares a process but nothing else), a facade handshake,
+    a mid-stream broadcast swap, and the post-swap version pin on
+    spans — with zero recompiles on either worker engine."""
+    rng = np.random.RandomState(1)
+    weights = {"w": rng.randn(C, D).astype(np.float32)}
+    engines = []
+    for _ in range(2):
+        e = ServingEngine({k: v.copy() for k, v in weights.items()},
+                          buckets=(1, 8))
+        e.warmup()
+        engines.append(e)
+    cc0 = [e.compile_count for e in engines]
+    workers = [PodWorker(e, worker_id=i).start()
+               for i, e in enumerate(engines)]
+    try:
+        eps = [("127.0.0.1", w.port) for w in workers]
+        pod = PodClientEngine(eps)
+        assert pod.buckets == (1, 8) and pod.input_dim == D
+        reps = [Replica(i, pod, transport=SocketTransport(
+            eps[i], client=pod, host_index=i))
+            for i in range(2)]
+        tracer = Tracer()
+        with FailoverRouter(reps, policy="round_robin") as router:
+            with ServingService(router, tracer=tracer) as svc:
+                pre = [svc.submit(rows(2, seed=i), timeout_s=30.0)
+                       for i in range(6)]
+                for f in pre:
+                    f.result(timeout=30)
+                v = router.swap_weights(
+                    {"w": rng.randn(C, D).astype(np.float32)})
+                post = [svc.submit(rows(2, seed=i), timeout_s=30.0)
+                        for i in range(6)]
+                for f in post:
+                    f.result(timeout=30)
+        assert v == 1
+        assert [e.version for e in engines] == [1, 1]
+        post_ids = {f.request_id for f in post}
+        req_spans = [r for r in tracer.records()
+                     if r["name"] == "request"]
+        vers = {r["attrs"]["model_version"] for r in req_spans
+                if r["trace_id"] in post_ids}
+        assert vers == {1}
+        # the zero-recompile pin crosses the process seam: weights
+        # stay call arguments on every worker
+        assert [e.compile_count for e in engines] == cc0
+    finally:
+        for w in workers:
+            w.stop()
+
+
+def test_socket_dispatch_single_row_duality():
+    # the engine.predict row/batch duality crosses the wire: a (d,)
+    # row dispatches as (1, d) and comes back as a (C,) row
+    engine = make_engine()
+    with PodWorker(engine) as w:
+        with SocketTransport(("127.0.0.1", w.port)) as t:
+            x = rows(1)[0]
+            out = t.dispatch(x)
+            assert out.shape == (C,)
+            assert np.allclose(out, engine.predict(x), atol=0)
+
+
+def test_concurrent_swaps_serialize_one_agreed_version():
+    """Review pin (the one-agreed-version invariant under
+    concurrency): two racing swap_weights announces must SERIALIZE —
+    distinct version numbers, every worker converging on the same
+    final weights under the same final number — never two different
+    weight sets wearing one version."""
+    engines = [StubEngine(seed=1), StubEngine(seed=1)]
+    workers = [PodWorker(e, worker_id=i).start()
+               for i, e in enumerate(engines)]
+    try:
+        eps = [("127.0.0.1", w.port) for w in workers]
+        pod = PodClientEngine(eps)
+        wa, wb = rows(C, seed=100), rows(C, seed=200)
+        got = []
+        barrier = threading.Barrier(2)
+
+        def swap(wts):
+            barrier.wait()
+            got.append(pod.swap_weights({"w": wts}))
+
+        ts = [threading.Thread(target=swap, args=(w,))
+              for w in (wa, wb)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        # distinct numbers — nobody raced into the other's slot
+        assert sorted(got) == [1, 2]
+        assert pod.version == 2
+        # and the POD agrees with itself: same version, same weights
+        # on every worker (last announce wins everywhere)
+        assert [e.version for e in engines] == [2, 2]
+        assert np.array_equal(engines[0].W, engines[1].W)
+    finally:
+        for w in workers:
+            w.stop()
+
+
+def test_one_d_engine_output_keeps_rank_across_the_wire():
+    """Review pin (transport shape equivalence): a hosted engine
+    answering 1-D predictions must come back 1-D — the wire's
+    (rows, cols) framing cannot silently promote it to a column."""
+
+    class OneD(StubEngine):
+        def predict(self, X, version=None, record_timings=True):
+            return super().predict(X).argmax(-1).astype(np.float32)
+
+    engine = OneD()
+    with PodWorker(engine) as w:
+        with SocketTransport(("127.0.0.1", w.port)) as t:
+            direct = InProcessTransport(engine)
+            X = rows(5)
+            assert t.dispatch(X).shape == direct.dispatch(X).shape \
+                == (5,)
+
+
+def test_reconnects_counts_only_reconnects():
+    # the first lazy connect is not recovery evidence; a drop and a
+    # fresh connect afterwards is
+    engine = StubEngine()
+    with PodWorker(engine) as w:
+        with SocketTransport(("127.0.0.1", w.port)) as t:
+            t.dispatch(rows(1))
+            assert t.reconnects == 0
+            t.close()  # drop the connection
+            t.dispatch(rows(1))
+            assert t.reconnects == 1
+
+
+def test_lag_stall_spends_the_deadline_budget():
+    """Review pin: a lag cell that outlives the remaining budget must
+    end in TransportTimeout BEFORE any I/O — a stale pre-stall budget
+    read would ship a positive-looking budget_s header for a caller
+    who already gave up."""
+    engine = StubEngine()
+    plan = NetChaosPlan.scripted(1, lags={0: [0]}, horizon=8,
+                                 lag_s=0.15)
+    with PodWorker(engine) as w:
+        with SocketTransport(("127.0.0.1", w.port), chaos=plan,
+                             host_index=0, n_hosts=1) as t:
+            with pytest.raises(TransportTimeout, match="exhausted"):
+                t.dispatch(rows(1),
+                           deadline=time.perf_counter() + 0.05)
+    assert w.dispatches == 0  # nothing crossed the wire
+
+
+def test_net_chaos_plan_rejects_negative_kill_index():
+    with pytest.raises(ValueError, match="must be >= 0"):
+        NetChaosPlan.scripted(2, kills={0: -3})

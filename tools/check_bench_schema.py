@@ -59,7 +59,12 @@ Three artifact families, three rule sets:
   every fleet, the beat re-checked NUMERICALLY (autoscaled strictly
   above every fixed fleet), interactive attainment held while batch
   shed, >= 1 scale-up, zero lost accepted requests, zero recompiles,
-  exactly-once spans.
+  exactly-once spans. From schema v8 on, the ``pod`` section (the
+  ISSUE 15 cross-process serving leg) is required too: a worker pod
+  of >= 2 processes, at least one SIGKILL and one network partition
+  actually fired, zero lost accepted requests, exactly-once spans
+  with the trace context propagated across the wire, and zero
+  recompiles on every surviving worker.
 - ``MULTICHIP_rNN.json`` — the dryrun wrapper: ``n_devices``/``rc``/
   ``ok``/``tail``, with ``ok`` true iff ``rc == 0`` (a disagreeing
   pair is exactly the silent-green failure this tool exists to catch).
@@ -191,6 +196,7 @@ def check_serve_artifact(art: dict, name: str) -> list[str]:
     errs.extend(_check_telemetry_section(art, schema))
     errs.extend(_check_continuous_section(art, schema))
     errs.extend(_check_overload_section(art, schema))
+    errs.extend(_check_pod_section(art, schema))
     return errs
 
 
@@ -579,6 +585,62 @@ def _check_overload_section(art: dict, schema: str) -> list[str]:
         errs.append("overload: 'spans_exactly_once' must be true "
                     "(every submitted request id — shed ones "
                     "included — lands one span)")
+    return errs
+
+
+def _check_pod_section(art: dict, schema: str) -> list[str]:
+    """The v8+ ``pod`` contract (the ISSUE 15 cross-process serving
+    leg): a multi-process worker pod must have been exercised for
+    real — at least two workers, at least one SIGKILL and one network
+    partition actually FIRED (a pod leg whose chaos never fired
+    proves nothing) — and the abort-grade pins are re-checked at the
+    gate so a hand-edited artifact can never land green: zero lost
+    accepted requests, exactly-once request spans with the trace
+    context propagated across the wire, and zero recompiles on every
+    surviving worker (the pod rides the AOT artifact plane). Earlier
+    schema versions predate the leg and are grandfathered."""
+    if not schema.startswith("BENCH_SERVE."):
+        return []  # family error already reported by the caller
+    version = _schema_version(schema)
+    if version is None:
+        return []  # the rollout check already reported it
+    if version < 8:
+        return []
+    pod = art.get("pod")
+    if not isinstance(pod, dict):
+        return ["schema v8+ requires a 'pod' section (the "
+                "cross-process serving leg)"]
+    errs = []
+    if not isinstance(pod.get("workers"), int) or pod["workers"] < 2:
+        errs.append("pod: 'workers' must be an int >= 2 (one process "
+                    "is not a pod)")
+    if not isinstance(pod.get("requests"), int) or pod["requests"] < 1:
+        errs.append("pod: 'requests' must be a positive int")
+    if not isinstance(pod.get("kills_fired"), int) \
+            or pod["kills_fired"] < 1:
+        errs.append("pod: 'kills_fired' must be >= 1 (a pod leg that "
+                    "never killed a worker process proves nothing)")
+    if not isinstance(pod.get("partitions_fired"), int) \
+            or pod["partitions_fired"] < 1:
+        errs.append("pod: 'partitions_fired' must be >= 1 (a pod leg "
+                    "that never partitioned a route proves nothing)")
+    if pod.get("lost") != 0:
+        errs.append(f"pod: lost={pod.get('lost')!r} — every accepted "
+                    "request must resolve typed across the wire; a "
+                    "committed artifact may never carry lost requests")
+    if pod.get("spans_exactly_once") is not True:
+        errs.append("pod: 'spans_exactly_once' must be true (every "
+                    "accepted request id lands one span, worker "
+                    "deaths included)")
+    if pod.get("trace_propagated") is not True:
+        errs.append("pod: 'trace_propagated' must be true (worker-"
+                    "side spans must carry router-sent trace ids — "
+                    "the TRACECTX.v1 cross-process contract)")
+    if pod.get("survivor_recompiles") != 0:
+        errs.append("pod: survivor_recompiles="
+                    f"{pod.get('survivor_recompiles')!r} — workers "
+                    "load the AOT artifact; a surviving worker must "
+                    "never compile")
     return errs
 
 

@@ -72,6 +72,14 @@ HOT_PATHS = {
         "Replica.predict", "FailoverRouter.predict",
         "FailoverRouter._dispatch", "FailoverRouter._attempt",
         "FailoverRouter._pick"},
+    "serving/transport.py": {
+        # the ISSUE 15 cross-process seam: the client dispatch (runs
+        # per batch on the serving worker, socket I/O under its
+        # exchange lock — the GL004 surface) and the worker-side serve
+        # loop (every pod request crosses it; a host sync or
+        # shape-keyed cache here taxes the whole pod)
+        "InProcessTransport.dispatch", "SocketTransport.dispatch",
+        "PodWorker._serve_conn", "PodWorker._handle_dispatch"},
 }
 
 #: Attribute reads that yield PYTHON values on a tracer (static under

@@ -35,6 +35,10 @@ _BLOCKING_DOTTED = {
     "subprocess.run": "subprocess.run",
     "subprocess.check_output": "subprocess",
     "wait": "concurrent.futures.wait",
+    # the ISSUE 15 socket vocabulary: a lock held across any of these
+    # stalls every thread contending for it by a network round-trip
+    "socket.create_connection": "socket connect",
+    "socket.create_server": "socket bind/listen",
 }
 
 #: Attribute-call patterns that block: attr -> (label, value-source
@@ -53,6 +57,12 @@ _BLOCKING_ATTRS = {
     "write": ("file write", ("file",)),
     "flush": ("file flush", ("file",)),
     "read": ("file read", ("file",)),
+    # blocking-socket spellings (ISSUE 15): distinctive enough to
+    # match unconditionally — nothing else in the package names them
+    "recv": ("socket recv", ()),
+    "sendall": ("socket send", ()),
+    "accept": ("socket accept", ()),
+    "connect": ("socket connect", ()),
 }
 
 #: GL003 device->host conversion entry points (numpy tails).
