@@ -1,0 +1,48 @@
+"""Scenario fuzzing over the composed fault grammars (ISSUE 16).
+
+The repo owns four seeded adversity grammars, each proven in
+isolation: ``fedcore.faults.FaultSpec`` (the train-side client fault
+plane), ``serving.chaos.ChaosSpec`` (replica chaos), ``LoadSpec``
+(offered-load shapes) and ``NetChaosSpec`` (the wire). This package
+composes them: one :class:`ScenarioSpec` draws all four — plus
+mid-stream weight swaps, worker kills/rejoins and scripted autoscale
+events — from ONE master seed via splittable sub-seed derivation
+(``utils.seeds.derive_seed``), a :class:`PropertyOracle` runs the
+composed scenario end-to-end (train leg through the fault/defense
+plane, serve leg through a socket-transport pod behind the failover
+router and admission control) and asserts the repo's standing
+invariants as typed :class:`Violation` records, and
+:func:`run_campaign` sweeps seeds and intensities under a budget,
+shrinking any failure (:func:`shrink`) to a minimal reproduction a
+pytest collector replays as a tier-1 regression test
+(``campaigns/regressions/*.json``).
+
+Determinism contract (the same one every grammar carries): the same
+master seed expands to the bitwise-identical scenario schedule, and a
+campaign at one seed produces the identical ``CAMPAIGN.v1`` artifact
+modulo wall-clock fields.
+"""
+
+from .campaign import (CAMPAIGN_SCHEMA, REGRESSION_SCHEMA,
+                       load_regression, run_campaign, shrink,
+                       write_regression)
+from .oracle import (INVARIANTS, OracleEngine, PropertyOracle, Verdict,
+                     Violation)
+from .spec import ScenarioEvent, ScenarioPlan, ScenarioSpec
+
+__all__ = [
+    "CAMPAIGN_SCHEMA",
+    "INVARIANTS",
+    "OracleEngine",
+    "PropertyOracle",
+    "REGRESSION_SCHEMA",
+    "ScenarioEvent",
+    "ScenarioPlan",
+    "ScenarioSpec",
+    "Verdict",
+    "Violation",
+    "load_regression",
+    "run_campaign",
+    "shrink",
+    "write_regression",
+]

@@ -698,9 +698,11 @@ class PodClientEngine:
         (explicit, or announced-live + 1), pack the weights once, and
         announce to every endpoint. Returns the agreed version once at
         least one worker acked; dead workers are skipped (their
-        circuits are open anyway — a worker that rejoins must be
-        re-fed by its operator, the cross-process registry carried in
-        ROADMAP). Raises :class:`TransportError` when NO worker
+        circuits are open anyway — a worker that rejoins catches up
+        itself via the ``sync`` handshake: ``PodWorker(peers=...)``
+        re-requests the agreed version from the pod on start, closing
+        the announce gap without operator re-feeding, ISSUE 16).
+        Raises :class:`TransportError` when NO worker
         acked — an announce nobody heard must not bump the client's
         notion of live."""
         if params is None:
@@ -768,9 +770,21 @@ class PodWorker:
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  worker_id: int = 0, tracer=None,
-                 max_frame_bytes: int = MAX_FRAME_BYTES):
+                 max_frame_bytes: int = MAX_FRAME_BYTES, peers=None):
+        """``peers`` (ISSUE 16, the announce-gap fix): pod endpoints
+        this worker re-requests the agreed weight version from on
+        :meth:`start`. A worker rejoining after SIGKILL restarts from
+        its checkpoint — STALE weights under a stale version — and
+        version announces only reach workers alive at announce time,
+        so without the handshake the rejoiner serves old weights under
+        the pod's name until an operator re-feeds it. With peers set,
+        ``start`` syncs BEFORE accepting connections: the worker asks
+        each peer (``sync`` frame), installs the newest version found,
+        and only then serves."""
         self.engine = engine
         self.worker_id = int(worker_id)
+        self.peers = [(str(h), int(p)) for h, p in (peers or [])]
+        self.resyncs = 0
         self.tracer = tracer if tracer is not None else get_tracer()
         self.max_frame_bytes = int(max_frame_bytes)
         # capability check once, like ServingService does: whether the
@@ -797,6 +811,11 @@ class PodWorker:
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> "PodWorker":
+        if self.peers:
+            # sync BEFORE serve: a rejoiner must not answer dispatches
+            # with checkpoint-stale weights while the agreed version
+            # is one frame away
+            self.resync()
         t = threading.Thread(target=self._accept_loop,
                              name=f"pod-worker-{self.worker_id}",
                              daemon=True)
@@ -835,6 +854,43 @@ class PodWorker:
 
     def __exit__(self, *exc):
         self.stop()
+
+    def resync(self, timeout_s: float = 5.0) -> int | None:
+        """Re-request the pod's agreed weight version from ``peers``.
+
+        Asks every peer (each on its own short-lived connection, the
+        control-frame discipline), then installs the NEWEST version
+        found when it is newer than what this worker serves — newest,
+        not first-answering, because a pod mid-announce has peers on
+        two versions and joining the older side would re-open the gap
+        one announce later. Unreachable or weightless peers are
+        skipped: a lone survivor restarting a dead pod has nobody to
+        ask and must still come up. Returns the installed version, or
+        None when nothing newer was found."""
+        best_v, best_payload = None, b""
+        my_v = int(getattr(self.engine, "version", 0))
+        for ep in self.peers:
+            try:
+                with socket.create_connection(
+                        ep, timeout=timeout_s) as sock:
+                    sock.settimeout(timeout_s)
+                    write_frame(sock, {"kind": "sync"})
+                    resp, payload = read_frame(sock,
+                                               self.max_frame_bytes)
+            except (TransportError, FrameError, OSError):
+                continue  # dead/refusing peer: ask the next one
+            if resp.get("kind") != "weights":
+                continue  # peer hosts nothing exportable
+            v = int(resp.get("version", 0))
+            if v > my_v and (best_v is None or v > best_v):
+                best_v, best_payload = v, payload
+        if best_v is None:
+            return None
+        params, rff = unpack_weights(best_payload)
+        v = self.engine.swap_weights(params, rff=rff, version=best_v)
+        with self._lock:
+            self.resyncs += 1
+        return int(v)
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -920,8 +976,10 @@ class PodWorker:
             served = self.dispatches
             swaps = self.swaps
             errors = self.errors
+            resyncs = self.resyncs
         return {
             "kind": "meta", "worker": self.worker_id,
+            "resyncs": resyncs,
             "buckets": [int(b) for b in self.engine.buckets],
             "input_dim": int(self.engine.input_dim),
             "num_classes": int(self.engine.num_classes),
@@ -940,6 +998,8 @@ class PodWorker:
             return {"kind": "ok"}, b""
         if kind == "swap":
             return self._handle_swap(header, payload)
+        if kind == "sync":
+            return self._handle_sync()
         if kind == "dispatch":
             return self._handle_dispatch(header, payload)
         raise FrameError(f"unknown frame kind {kind!r}")
@@ -960,6 +1020,20 @@ class PodWorker:
             self.swaps += 1
         return {"kind": "ok", "version": int(v),
                 "worker": self.worker_id}, b""
+
+    def _handle_sync(self) -> tuple:
+        """A rejoining peer's weight request (:meth:`resync`): serve
+        the LIVE weights under their version so the rejoiner lands on
+        the pod's agreed state without operator involvement. A worker
+        whose engine exports no weight pytree answers its meta instead
+        — the rejoiner skips it and asks the next peer."""
+        params = getattr(self.engine, "params", None)
+        if params is None:
+            return self._meta(), b""
+        blob = pack_weights(params, getattr(self.engine, "rff", None))
+        return {"kind": "weights",
+                "version": int(getattr(self.engine, "version", 0)),
+                "worker": self.worker_id}, blob
 
     def _handle_dispatch(self, header: dict, payload: bytes) -> tuple:
         budget = header.get("budget_s")
@@ -1016,7 +1090,7 @@ class PodWorker:
 def worker_main(port_file: str, artifact_dir: str | None = None,
                 checkpoint: str | None = None, host: str = "127.0.0.1",
                 worker_id: int = 0, trace_dir: str | None = None,
-                buckets=None, engine=None) -> None:
+                buckets=None, engine=None, peers=None) -> None:
     """Subprocess entry: host one pod worker until killed or told to
     ``stop``. ``artifact_dir`` loads a PR 9 AOT artifact
     (``ServingEngine.from_artifact`` — ready in load-milliseconds,
@@ -1025,7 +1099,10 @@ def worker_main(port_file: str, artifact_dir: str | None = None,
     (tmp + rename) once the listener is up — the spawner polls it.
     ``trace_dir`` streams the worker's spans through a rotating JSONL
     writer (O(1) memory; parts named ``podworker<id>-*``), which is
-    how the bench reads the cross-process trace back."""
+    how the bench reads the cross-process trace back. ``peers`` lists
+    pod endpoints to re-request the agreed weight version from before
+    serving (the rejoin handshake — pass the surviving workers when
+    respawning a killed one)."""
     tracer = None
     if trace_dir:
         from ..utils.trace import RotatingJsonlWriter, Tracer
@@ -1047,7 +1124,7 @@ def worker_main(port_file: str, artifact_dir: str | None = None,
                 "worker_main needs artifact_dir, checkpoint, or "
                 "engine=")
     worker = PodWorker(engine, host=host, worker_id=worker_id,
-                       tracer=tracer)
+                       tracer=tracer, peers=peers)
     worker.start()
     tmp = f"{port_file}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:

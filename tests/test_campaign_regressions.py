@@ -1,0 +1,48 @@
+"""Replay every committed campaign regression (ISSUE 16).
+
+``campaigns/regressions/*.json`` holds minimal repros shrunk from
+scenario-fuzzing campaign failures (``tools/run_campaign.py``). Each
+file records the scenario spec that USED to violate the invariant
+codes in ``fixed_codes`` — committing one asserts the bug is fixed,
+and this collector replays them all forever: a repro that fails again
+here is a regression of the original fix, with the shrunk spec as the
+ready-made reproduction command.
+
+Promotion workflow (README "Scenario campaigns"): a campaign failure
+is auto-shrunk, the minimal repro lands in ``campaigns/regressions/``,
+the bug gets fixed, the repro file gets committed with the fix, and
+tier-1 replays it from then on. Files are tiny (one spec string + the
+shrink trace), so the whole directory stays tier-1.
+"""
+
+import glob
+import os
+
+import pytest
+
+from fedamw_tpu.scenario import PropertyOracle, load_regression
+
+pytestmark = pytest.mark.scenario
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REG_DIR = os.path.join(REPO, "campaigns", "regressions")
+REG_FILES = sorted(glob.glob(os.path.join(REG_DIR, "*.json")))
+
+
+def test_regression_directory_is_nonempty():
+    # the collector below parametrizes over files; an accidentally
+    # emptied directory would silently pass, so pin that at least the
+    # announce-gap repro (the PR 16 founding regression) is present
+    assert REG_FILES, f"no committed regressions under {REG_DIR}"
+
+
+@pytest.mark.parametrize(
+    "path", REG_FILES, ids=[os.path.basename(p) for p in REG_FILES])
+def test_committed_regression_replays_clean(path):
+    rec = load_regression(path)
+    verdict = PropertyOracle().run(rec["spec"])
+    assert verdict.ok, (
+        f"{os.path.basename(path)} regressed: the shrunk repro "
+        f"{rec['spec']!r} violates {verdict.codes()} again "
+        f"(originally fixed: {rec['fixed_codes']}) — "
+        f"{verdict.violations}")

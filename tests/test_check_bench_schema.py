@@ -742,3 +742,97 @@ def test_graftlint_artifact_validates_and_rejects_drift(tmp_path):
     art["schema"] = "GRAFTLINT.v1-rc1"
     errs = cbs.validate_file(_write(tmp_path, "GRAFTLINT_r01.json", art))
     assert any("unparseable schema version" in e for e in errs)
+
+
+# -- CAMPAIGN.v1 (ISSUE 16: the scenario-fuzzing campaign artifact) ---
+
+def _campaign_art(**over):
+    verdict = {"spec": "seed=1,rounds=2,clients=4,replicas=2,"
+                       "requests=12,faults=0.2,chaos=0,load=0,net=0,"
+                       "swaps=0,kills=0,scales=0",
+               "digest": "ab" * 32, "codes": [], "ok": True,
+               "counts": {"served": 12}}
+    art = {"schema": "CAMPAIGN.v1", "seed": 3, "budget": 2,
+           "scenarios": 2, "failures": 0, "truncated": False,
+           "digest": "cd" * 32, "verdicts": [dict(verdict),
+                                             dict(verdict)],
+           "violations": [], "wall_s": 0.5}
+    art.update(over)
+    return art
+
+
+def test_campaign_v1_minimal_artifact_validates(tmp_path):
+    p = _write(tmp_path, "CAMPAIGN_x.json", _campaign_art())
+    assert cbs.validate_file(p) == []
+    # truncated short campaigns are honest and pass
+    p2 = _write(tmp_path, "CAMPAIGN_y.json",
+                _campaign_art(scenarios=1, truncated=True,
+                              verdicts=_campaign_art()["verdicts"][:1]))
+    assert cbs.validate_file(p2) == []
+
+
+def test_campaign_rejects_committed_failures(tmp_path):
+    bad_v = dict(_campaign_art()["verdicts"][0],
+                 codes=["RECOMPILE"], ok=False)
+    art = _campaign_art(
+        failures=1,
+        verdicts=[_campaign_art()["verdicts"][0], bad_v],
+        violations=[{"index": 1, "verdict": bad_v}])
+    p = _write(tmp_path, "CAMPAIGN_x.json", art)
+    errs = cbs.validate_file(p)
+    assert any("must be clean" in e for e in errs)
+
+
+def test_campaign_rejects_malformed_digest(tmp_path):
+    for digest in ("", "xyz", "AB" * 32, "ab" * 31):
+        p = _write(tmp_path, "CAMPAIGN_x.json",
+                   _campaign_art(digest=digest))
+        assert any("sha256" in e for e in cbs.validate_file(p))
+
+
+def test_campaign_rejects_silent_truncation(tmp_path):
+    art = _campaign_art(scenarios=1,
+                        verdicts=_campaign_art()["verdicts"][:1])
+    p = _write(tmp_path, "CAMPAIGN_x.json", art)
+    errs = cbs.validate_file(p)
+    assert any("without truncated=true" in e for e in errs)
+    # and a count that exceeds the budget is impossible
+    art2 = _campaign_art(scenarios=3, budget=2)
+    p2 = _write(tmp_path, "CAMPAIGN_x.json", art2)
+    assert any("exceeds budget" in e for e in cbs.validate_file(p2))
+
+
+def test_campaign_rejects_ok_codes_disagreement(tmp_path):
+    art = _campaign_art()
+    art["verdicts"][1] = dict(art["verdicts"][1],
+                              codes=["LOST_REQUEST"], ok=True)
+    p = _write(tmp_path, "CAMPAIGN_x.json", art)
+    errs = cbs.validate_file(p)
+    assert any("disagrees with codes" in e for e in errs)
+    # the inverse disagreement is red, so it must ALSO carry a
+    # violation record
+    art2 = _campaign_art()
+    art2["verdicts"][1] = dict(art2["verdicts"][1], ok=False)
+    p2 = _write(tmp_path, "CAMPAIGN_x.json", art2)
+    errs2 = cbs.validate_file(p2)
+    assert any("disagrees with codes" in e for e in errs2)
+    assert any("red verdict" in e for e in errs2)
+
+
+def test_campaign_rejects_bad_shrink_trace(tmp_path):
+    bad_v = dict(_campaign_art()["verdicts"][0],
+                 codes=["RECOMPILE"], ok=False)
+    base = dict(failures=1,
+                verdicts=[_campaign_art()["verdicts"][0], bad_v])
+    # shrunk without its spec/codes/trace
+    art = _campaign_art(**base, violations=[
+        {"index": 1, "verdict": bad_v, "shrunk": {"spec": "seed=1"}}])
+    p = _write(tmp_path, "CAMPAIGN_x.json", art)
+    assert any("spec/codes/trace" in e for e in cbs.validate_file(p))
+    # trace steps missing action/spec/kept
+    art2 = _campaign_art(**base, violations=[
+        {"index": 1, "verdict": bad_v,
+         "shrunk": {"spec": "seed=1", "codes": ["RECOMPILE"],
+                    "trace": [{"action": "drop:faults"}]}}])
+    p2 = _write(tmp_path, "CAMPAIGN_x.json", art2)
+    assert any("action/spec/kept" in e for e in cbs.validate_file(p2))

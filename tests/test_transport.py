@@ -859,3 +859,97 @@ def test_lag_stall_spends_the_deadline_budget():
 def test_net_chaos_plan_rejects_negative_kill_index():
     with pytest.raises(ValueError, match="must be >= 0"):
         NetChaosPlan.scripted(2, kills={0: -3})
+
+
+# -- rejoin resync: the announce-gap fix (ISSUE 16) --------------------
+
+def test_rejoining_worker_resyncs_to_agreed_version():
+    """A swap announced while a worker is down used to leave the
+    rejoiner serving stale weights under the pod's name (the announce
+    gap). The ``sync`` handshake closes it: a worker started with
+    ``peers=`` re-requests the agreed version before serving."""
+    survivor = make_engine()
+    with PodWorker(survivor, worker_id=0) as wa:
+        pod = PodClientEngine([("127.0.0.1", wa.port)])
+        new_w = rows(C, seed=42)
+        assert pod.swap_weights({"w": new_w}) == 1
+        # the rejoiner: fresh engine still on version 0 weights
+        rejoiner = make_engine()
+        with PodWorker(rejoiner, worker_id=1,
+                       peers=[("127.0.0.1", wa.port)]) as wb:
+            assert rejoiner.version == 1
+            assert np.array_equal(
+                np.asarray(rejoiner.params["w"]), new_w)
+            assert wb.resyncs == 1
+            # the handshake surfaces in the meta frame
+            meta, _ = pod.control(("127.0.0.1", wb.port),
+                                  {"kind": "hello"})
+            assert meta["resyncs"] == 1 and meta["version"] == 1
+            # and the rejoiner serves the synced weights on the wire
+            with SocketTransport(("127.0.0.1", wb.port),
+                                 client=pod) as t:
+                X = rows(2)
+                np.testing.assert_allclose(
+                    t.dispatch(X), X @ new_w.T, rtol=1e-5)
+
+
+def test_resync_picks_newest_version_not_first_peer():
+    old, new = make_engine(), make_engine()
+    old.swap_weights({"w": rows(C, seed=7)}, version=1)
+    new.swap_weights({"w": rows(C, seed=9)}, version=3)
+    with PodWorker(old) as wo, PodWorker(new) as wn:
+        rejoiner = make_engine()
+        w = PodWorker(rejoiner, peers=[("127.0.0.1", wo.port),
+                                       ("127.0.0.1", wn.port)])
+        with w:
+            assert rejoiner.version == 3
+            assert np.array_equal(np.asarray(rejoiner.params["w"]),
+                                  rows(C, seed=9))
+
+
+def test_resync_skips_weightless_and_dead_peers():
+    # a peer whose engine exports no params answers meta (skipped);
+    # a dead endpoint is skipped; a lone survivor must still come up
+    with PodWorker(StubEngine(seed=1)) as stub_w:
+        with socket.socket() as probe:
+            probe.bind(("127.0.0.1", 0))
+            dead = probe.getsockname()[1]
+        eng = make_engine()
+        w = PodWorker(eng, peers=[("127.0.0.1", dead),
+                                  ("127.0.0.1", stub_w.port)])
+        with w:
+            assert w.resync() is None  # nothing newer anywhere
+            assert eng.version == 0 and w.resyncs == 0
+            # and it serves regardless: rejoin must not deadlock on
+            # an unsyncable pod
+            pod = PodClientEngine([("127.0.0.1", w.port)])
+            with SocketTransport(("127.0.0.1", w.port),
+                                 client=pod) as t:
+                assert t.dispatch(rows(2)).shape == (2, C)
+
+
+def test_resync_ignores_older_peer_versions():
+    # joining the OLDER side of a mid-announce pod would re-open the
+    # gap one announce later; a peer behind this worker is ignored
+    behind = make_engine()  # version 0
+    with PodWorker(behind) as wb:
+        eng = make_engine()
+        eng.swap_weights({"w": rows(C, seed=11)}, version=5)
+        w = PodWorker(eng, peers=[("127.0.0.1", wb.port)])
+        with w:
+            assert eng.version == 5 and w.resyncs == 0
+
+
+def test_sync_frame_serves_live_weights_over_the_wire():
+    eng = make_engine()
+    eng.swap_weights({"w": rows(C, seed=13)}, version=2)
+    with PodWorker(eng) as w:
+        with socket.create_connection(("127.0.0.1", w.port),
+                                      timeout=5.0) as sock:
+            sock.settimeout(5.0)
+            write_frame(sock, {"kind": "sync"})
+            resp, payload = read_frame(sock, 1 << 30)
+        assert resp["kind"] == "weights" and resp["version"] == 2
+        params, rff = unpack_weights(payload)
+        assert np.array_equal(np.asarray(params["w"]),
+                              rows(C, seed=13))

@@ -76,6 +76,17 @@ Three artifact families, three rule sets:
   exists to stop), and every ``suppressed`` entry carrying its
   mandatory reason — the audit trail that makes an inline disable an
   argued exception instead of a silence.
+- ``CAMPAIGN_*.json`` — ``tools/run_campaign.py``'s scenario-fuzzing
+  artifact (the ISSUE 16 campaign plane): ``schema`` in the
+  ``CAMPAIGN.`` family, the campaign ``seed``, budget/scenario counts
+  that agree (``scenarios == budget`` unless honestly ``truncated``),
+  one verdict per scenario (parseable canonical spec string, schedule
+  digest, ``ok`` consistent with its violation codes), a ``failures``
+  count that matches the red verdicts, every violation's shrink trace
+  well-formed, and — the committed-artifact contract — ZERO failures:
+  a campaign artifact carrying violations is an unfixed bug wearing a
+  green filename; the shrunk repro belongs in
+  ``campaigns/regressions/`` next to its fix.
 - ``SCALE_rNN.json`` — ``scale_bench.py``'s own artifact (the ISSUE 8
   cohort plane): ``schema`` in the ``SCALE.`` family, a ``platform``
   label, a non-empty ``records`` list, and — from schema v1 on — a
@@ -103,7 +114,7 @@ import sys
 #: Filename prefix -> validator. Order matters: BENCH_SERVE_ must be
 #: tested before the BENCH_ prefix it also matches.
 FAMILIES = ("BENCH_SERVE_", "BENCH_", "MULTICHIP_", "SCALE_",
-            "GRAFTLINT_")
+            "GRAFTLINT_", "CAMPAIGN_")
 
 
 def _tail_json_lines(tail: str) -> list[dict]:
@@ -783,12 +794,126 @@ def check_graftlint_artifact(art: dict, name: str) -> list[str]:
     return errs
 
 
+def check_campaign_artifact(art: dict, name: str) -> list[str]:
+    """``tools/run_campaign.py``'s CAMPAIGN.vN artifact (the scenario
+    fuzzing plane)."""
+    errs = []
+    schema = str(art.get("schema", ""))
+    if not schema.startswith("CAMPAIGN."):
+        errs.append(f"schema must be in the CAMPAIGN. family, "
+                    f"got {art.get('schema')!r}")
+        return errs
+    try:
+        int(schema.rsplit(".v", 1)[1])
+    except (IndexError, ValueError):
+        errs.append(f"unparseable schema version {schema!r} "
+                    "(expected CAMPAIGN.vN)")
+    if not isinstance(art.get("seed"), int) or art["seed"] < 0:
+        errs.append("'seed' must be a non-negative int (the campaign "
+                    "master everything derives from)")
+    budget = art.get("budget")
+    scenarios = art.get("scenarios")
+    if not isinstance(budget, int) or budget < 1:
+        errs.append("'budget' must be a positive int")
+    if not isinstance(scenarios, int) or scenarios < 1:
+        errs.append("'scenarios' must be a positive int")
+    elif isinstance(budget, int):
+        if scenarios > budget:
+            errs.append(f"scenarios={scenarios} exceeds budget="
+                        f"{budget}")
+        elif scenarios < budget and art.get("truncated") is not True:
+            # a short campaign must say WHY it is short — a silently
+            # partial sweep reads as full coverage
+            errs.append(f"scenarios={scenarios} < budget={budget} "
+                        "without truncated=true")
+    digest = art.get("digest")
+    if not (isinstance(digest, str) and len(digest) == 64
+            and all(c in "0123456789abcdef" for c in digest)):
+        errs.append("'digest' must be the sha256 hex of the verdict "
+                    "sequence (the same-seed bitwise pin compares it)")
+    verdicts = art.get("verdicts")
+    red = 0
+    if not isinstance(verdicts, list):
+        errs.append("'verdicts' must be a list (one record per "
+                    "scenario run)")
+    else:
+        if isinstance(scenarios, int) and len(verdicts) != scenarios:
+            errs.append(f"{len(verdicts)} verdict(s) disagree with "
+                        f"scenarios={scenarios}")
+        for i, v in enumerate(verdicts):
+            if not isinstance(v, dict):
+                errs.append(f"verdicts[{i}]: must be a record")
+                continue
+            spec = v.get("spec")
+            if not isinstance(spec, str) or "seed=" not in spec:
+                errs.append(f"verdicts[{i}]: 'spec' must be the "
+                            "canonical scenario string")
+            if not isinstance(v.get("digest"), str) or not v["digest"]:
+                errs.append(f"verdicts[{i}]: missing schedule "
+                            "'digest'")
+            codes = v.get("codes")
+            if not isinstance(codes, list):
+                errs.append(f"verdicts[{i}]: 'codes' must be a list")
+            elif v.get("ok") is not (not codes):
+                # ok and codes are two views of ONE verdict
+                errs.append(f"verdicts[{i}]: ok={v.get('ok')!r} "
+                            f"disagrees with codes={codes!r}")
+            if not v.get("ok", True):
+                red += 1
+    violations = art.get("violations")
+    if not isinstance(violations, list):
+        errs.append("'violations' must be a list (the failing "
+                    "scenarios, with shrink traces)")
+    else:
+        if art.get("failures") != len(violations):
+            errs.append(f"failures={art.get('failures')!r} disagrees "
+                        f"with {len(violations)} violation record(s)")
+        if isinstance(verdicts, list) and len(violations) != red:
+            errs.append(f"{len(violations)} violation record(s) "
+                        f"disagree with {red} red verdict(s)")
+        for i, rec in enumerate(violations):
+            if not isinstance(rec, dict) \
+                    or not isinstance(rec.get("index"), int) \
+                    or not isinstance(rec.get("verdict"), dict):
+                errs.append(f"violations[{i}]: must carry its "
+                            "scenario 'index' and 'verdict' record")
+                continue
+            shrunk = rec.get("shrunk")
+            if shrunk is None:
+                continue  # --no-shrink triage sweeps are honest
+            if not isinstance(shrunk, dict) \
+                    or not isinstance(shrunk.get("spec"), str) \
+                    or not shrunk.get("codes") \
+                    or not isinstance(shrunk.get("trace"), list):
+                errs.append(f"violations[{i}]: 'shrunk' must carry "
+                            "spec/codes/trace (the minimal repro and "
+                            "how it was reached)")
+                continue
+            for j, step in enumerate(shrunk["trace"]):
+                if not isinstance(step, dict) or not all(
+                        k in step for k in ("action", "spec", "kept")):
+                    errs.append(f"violations[{i}].trace[{j}]: missing "
+                                "action/spec/kept")
+    if not isinstance(art.get("wall_s"), (int, float)) \
+            or art["wall_s"] < 0:
+        errs.append("missing non-negative numeric 'wall_s'")
+    if art.get("failures") != 0:
+        # the committed-artifact contract (the graftlint precedent): a
+        # campaign artifact may only land CLEAN — a violation belongs
+        # in campaigns/regressions/ next to the commit that fixes it
+        errs.append(f"failures={art.get('failures')!r} — a committed "
+                    "campaign artifact must be clean; shrunk repros "
+                    "belong in campaigns/regressions/ with their fix")
+    return errs
+
+
 CHECKERS = {
     "BENCH_SERVE_": check_serve_artifact,
     "BENCH_": check_bench_wrapper,
     "MULTICHIP_": check_multichip,
     "SCALE_": check_scale_artifact,
     "GRAFTLINT_": check_graftlint_artifact,
+    "CAMPAIGN_": check_campaign_artifact,
 }
 
 

@@ -80,6 +80,14 @@ HOT_PATHS = {
         # shape-keyed cache here taxes the whole pod)
         "InProcessTransport.dispatch", "SocketTransport.dispatch",
         "PodWorker._serve_conn", "PodWorker._handle_dispatch"},
+    "scenario/oracle.py": {
+        # the ISSUE 16 property oracle: these run inside the scenario's
+        # live serve leg (predict per pod dispatch, submit/event
+        # application interleaved with the request stream) — a host
+        # sync or fresh jit here would perturb the very timing and
+        # recompile behavior the oracle exists to certify
+        "OracleEngine.predict", "_ServeRun._submit_one",
+        "_ServeRun._apply_event", "_ServeRun.drive"},
 }
 
 #: Attribute reads that yield PYTHON values on a tracer (static under

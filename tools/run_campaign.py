@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Run a scenario-fuzzing campaign and write the CAMPAIGN.v1 artifact.
+
+Usage::
+
+    python tools/run_campaign.py --seed 7 --budget 200
+    python tools/run_campaign.py --seed 7 --budget 200 \
+        --out CAMPAIGN_fuzz.json --regressions campaigns/regressions
+
+Sweeps ``--budget`` composed scenarios (all derived from ``--seed``;
+see ``fedamw_tpu.scenario``) through the property oracle on CPU,
+writes the campaign artifact (validated by
+``tools/check_bench_schema.py``), and — when a scenario violates an
+invariant — shrinks it and drops the minimal repro into
+``--regressions``, where the pytest collector
+(``tests/test_campaign_regressions.py``) will replay it forever.
+
+Exit status: 0 when every scenario ran clean, 1 when any violated an
+invariant (the artifact and repro files are written either way).
+
+The artifact is deterministic per seed modulo ``wall_s`` and
+``truncated``: ``--time-budget-s`` exists for CI hygiene, but a
+truncated campaign's digest covers only the scenarios that ran —
+compare digests between runs only at equal scenario counts.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="seeded scenario-fuzzing campaign")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="campaign master seed (default 0)")
+    ap.add_argument("--budget", type=int, default=200,
+                    help="scenarios to run (default 200)")
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default CAMPAIGN_fuzz.json "
+                         "at the repo root)")
+    ap.add_argument("--regressions", default=None,
+                    help="directory for shrunk minimal repros "
+                         "(default campaigns/regressions)")
+    ap.add_argument("--no-shrink", action="store_true",
+                    help="record violations without shrinking "
+                         "(faster triage sweeps)")
+    ap.add_argument("--time-budget-s", type=float, default=None,
+                    help="stop starting new scenarios after this many "
+                         "seconds (artifact is marked truncated)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the per-scenario progress lines")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from fedamw_tpu.scenario import (PropertyOracle, ScenarioSpec,
+                                     run_campaign, write_regression)
+
+    out = args.out or os.path.join(_REPO, "CAMPAIGN_fuzz.json")
+    reg_dir = args.regressions or os.path.join(_REPO, "campaigns",
+                                               "regressions")
+    progress = None if args.quiet else (
+        lambda line: print(line, file=sys.stderr, flush=True))
+    artifact = run_campaign(
+        args.seed, args.budget, oracle=PropertyOracle(),
+        shrink_failures=not args.no_shrink,
+        time_budget_s=args.time_budget_s, progress=progress)
+
+    written = []
+    for failure in artifact["violations"]:
+        shrunk = failure.get("shrunk")
+        if shrunk is None:
+            continue
+        written.append(write_regression(
+            reg_dir, ScenarioSpec.parse(shrunk["spec"]),
+            shrunk["codes"], shrunk["trace"], campaign_seed=args.seed,
+            note=f"campaign seed {args.seed}, scenario index "
+                 f"{failure['index']}"))
+    tmp = f"{out}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, out)
+
+    n, bad = artifact["scenarios"], artifact["failures"]
+    print(f"{n} scenario(s), {bad} with violations "
+          f"({artifact['wall_s']}s) -> {out}")
+    for path in written:
+        print(f"  minimal repro: {path}")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
