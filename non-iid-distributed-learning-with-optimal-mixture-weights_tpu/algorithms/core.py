@@ -236,6 +236,15 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
             # clients start fully trusted; honest equilibrium evidence
             # is ~1.0, so reputation only moves on actual misbehavior
             st["rep"] = jnp.ones(num_clients, jnp.float32)
+        if rep_on and rspec.select_m is not None:
+            # krum/mkrum selection verdicts as one-round-delayed
+            # reputation evidence (ISSUE 18): the round-t selection
+            # mask and its candidate set ride the carry into round
+            # t+1's EWMA (selection runs AFTER the reputation step in
+            # the round pipeline). Start as everyone-selected /
+            # no-candidates so round 0 carries no phantom verdict
+            st["ksel"] = jnp.ones(num_clients, jnp.float32)
+            st["kcand"] = jnp.zeros(num_clients, jnp.float32)
         if zauto_on:
             # running clean-z quantile estimate (quarantine:auto)
             st["zq"] = jnp.float32(Z_AUTO_INIT)
@@ -347,7 +356,9 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
             dir_cos = directional_scores(params, stacked, present)
             rep_new = reputation_update(rep_prev, reported, scoreable,
                                         dir_cos, present, z, z_ref,
-                                        rspec.rep_decay)
+                                        rspec.rep_decay,
+                                        sel=dstate.get("ksel"),
+                                        sel_cand=dstate.get("kcand"))
             gate_new = jnp.where(rep_new >= rspec.rep_floor, 1.0, 0.0)
             aux["rep_gated"] = jnp.sum(reported * (1.0 - gate_new))
             aux["reputation"] = rep_new
@@ -469,6 +480,13 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                         # telemetry pins
                         selected = krum_select(params, stacked,
                                                present, sel_m)
+                        if rep_on:
+                            # feed this round's verdict to NEXT round's
+                            # reputation EWMA; candidacy recorded
+                            # BEFORE the fold (only considered clients
+                            # can be "deselected")
+                            dstate = dict(dstate, ksel=selected,
+                                          kcand=present)
                         present = present * selected
                         dfaux["krum_selected"] = selected
                     # Absent/quarantined clients carry EXACTLY zero
@@ -644,6 +662,13 @@ def _cached_round_trainer(init_fn, apply_fn, task, D, num_classes, num_clients,
                 loss_w = participation_weights(p_fixed, present)
                 agg, agg_aux = robust_round_aggregate(
                     params, stacked, w_t, present, ids)
+                if rep_on and agg_spec.select_m is not None:
+                    # fixed-path krum/mkrum: the aggregator's selection
+                    # telemetry is the same verdict the learned path
+                    # records — one-round-delayed evidence (ISSUE 18)
+                    dstate = dict(dstate,
+                                  ksel=agg_aux["krum_selected"],
+                                  kcand=present)
                 dfaux.update(agg_aux)
                 train_loss_t = jnp.sum(loss_w * losses)
             elif participation < 1.0:

@@ -143,6 +143,15 @@ DIR_Z_REF = 2.0
 # up when the observed delta norm implies MORE than FRAC_MARGIN x the
 # claimed work (honest norm scatter must not clamp honest claims)
 FRAC_MARGIN = 2.0
+# krum-deselection evidence erosion (ISSUE 18): a client the krum/mkrum
+# selector passed over keeps this fraction of its round evidence.
+# Deliberately 0.5, not 0 — krum deselects n-m clients EVERY round by
+# construction (most of them honest under m << n), so deselection is
+# weak evidence; the worst-case honest equilibrium under perpetual
+# deselection is rep ~ 0.5, safely above the 0.2 default floor, while
+# an attacker the selector consistently rejects compounds this with
+# the directional channel and decays geometrically anyway
+KRUM_DESEL_EROSION = 0.5
 
 # -- quarantine:auto threshold estimator ------------------------------
 # threshold = clip(Z_AUTO_MARGIN * m, Z_AUTO_MIN, Z_AUTO_MAX) where m
@@ -650,13 +659,14 @@ def trust_bounded_work_frac(norms: jax.Array, reported_frac: jax.Array,
 def reputation_update(rep: jax.Array, reported: jax.Array,
                       scoreable: jax.Array, dir_cos: jax.Array,
                       present: jax.Array, z: jax.Array | None, z_ref,
-                      decay: float):
-    """One EWMA reputation step over the two evidence channels
+                      decay: float, sel: jax.Array | None = None,
+                      sel_cand: jax.Array | None = None):
+    """One EWMA reputation step over the evidence channels
     (traced): ``rep' = decay * rep + (1 - decay) * evidence`` on every
     REPORTING client, unchanged elsewhere (an absent client's
     reputation neither decays nor recovers — no evidence either way).
 
-    Evidence is the product of two ``[0, 1]`` channels, masked by
+    Evidence is the product of ``[0, 1]`` channels, masked by
     ``scoreable`` (a client that reported non-finite garbage earns
     exactly zero evidence that round):
 
@@ -671,6 +681,14 @@ def reputation_update(rep: jax.Array, reported: jax.Array,
     - **norm**: ``exp(-max(z - z_ref, 0))`` over the work-normalized
       delta-norm z — full evidence below the (possibly auto-tuned)
       threshold, geometric decay beyond it.
+    - **selection** (optional, ISSUE 18): the PREVIOUS round's
+      krum/mkrum verdict — ``sel`` the 0/1 selected mask, ``sel_cand``
+      the mask of clients the selector actually considered. A
+      deselected candidate keeps :data:`KRUM_DESEL_EROSION` of its
+      evidence; selected clients and non-candidates are untouched.
+      One round delayed by construction: selection happens after the
+      reputation step in the round pipeline, so the verdict rides the
+      scan carry into the NEXT round's evidence (``algorithms.core``).
 
     Honest equilibrium is therefore evidence ~ 1.0 -> rep ~ 1.0; a
     persistent attacker's rep decays geometrically toward 0; a
@@ -688,6 +706,8 @@ def reputation_update(rep: jax.Array, reported: jax.Array,
     z_ev = (jnp.exp(-jnp.maximum(z - z_ref, 0.0)) if z is not None
             else jnp.ones_like(rep))
     ev = d_ev * z_ev * scoreable
+    if sel is not None:
+        ev = ev * (1.0 - KRUM_DESEL_EROSION * sel_cand * (1.0 - sel))
     ev = jnp.where(jnp.isfinite(ev), ev, 0.0)
     return jnp.where(reported > 0, decay * rep + (1.0 - decay) * ev, rep)
 

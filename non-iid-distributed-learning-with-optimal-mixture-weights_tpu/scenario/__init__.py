@@ -21,28 +21,46 @@ Determinism contract (the same one every grammar carries): the same
 master seed expands to the bitwise-identical scenario schedule, and a
 campaign at one seed produces the identical ``CAMPAIGN.v1`` artifact
 modulo wall-clock fields.
+
+ISSUE 18 adds the HUNTER on top of the sweep: :func:`run_search`
+replaces blind grid order with coverage-guided scheduling (rarity
+-priced candidate pool over :data:`COVERAGE_AXES`, near-miss mutation
+along the offending sub-grammar stream, an optional wall budget) and
+emits the ``CAMPAIGN.v2`` artifact — the v1 layout plus coverage
+accounting and per-verdict mutation lineage — under the same
+bitwise-per-seed contract.
 """
 
 from .campaign import (CAMPAIGN_SCHEMA, REGRESSION_SCHEMA,
                        load_regression, run_campaign, shrink,
                        write_regression)
-from .oracle import (INVARIANTS, OracleEngine, PropertyOracle, Verdict,
-                     Violation)
+from .oracle import (INVARIANTS, RACY_CODES, OracleEngine,
+                     PropertyOracle, Verdict, Violation)
+from .search import (CAMPAIGN_SCHEMA_V2, COVERAGE_AXES,
+                     actual_signature, hunt_grid, predicted_signature,
+                     run_search)
 from .spec import ScenarioEvent, ScenarioPlan, ScenarioSpec
 
 __all__ = [
     "CAMPAIGN_SCHEMA",
+    "CAMPAIGN_SCHEMA_V2",
+    "COVERAGE_AXES",
     "INVARIANTS",
     "OracleEngine",
     "PropertyOracle",
+    "RACY_CODES",
     "REGRESSION_SCHEMA",
     "ScenarioEvent",
     "ScenarioPlan",
     "ScenarioSpec",
     "Verdict",
     "Violation",
+    "actual_signature",
+    "hunt_grid",
     "load_regression",
+    "predicted_signature",
     "run_campaign",
+    "run_search",
     "shrink",
     "write_regression",
 ]

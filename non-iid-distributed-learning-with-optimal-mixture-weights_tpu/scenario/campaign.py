@@ -102,6 +102,19 @@ def campaign_digest(verdicts) -> str:
 # the shrinker
 # ---------------------------------------------------------------------
 
+def _reduce(spec: ScenarioSpec, **kw) -> ScenarioSpec:
+    """``dataclasses.replace`` plus the coupled-knob clamps: a
+    reduction of ``swaps`` or ``replicas`` drags ``announce_restarts``
+    down with it (the grammar requires one swap per race and one host
+    per race), so every candidate the shrinker proposes is a VALID
+    spec rather than a ``ValueError`` mid-shrink."""
+    swaps = kw.get("swaps", spec.swaps)
+    replicas = kw.get("replicas", spec.replicas)
+    ar = kw.get("announce_restarts", spec.announce_restarts)
+    kw["announce_restarts"] = min(ar, swaps, replicas)
+    return dataclasses.replace(spec, **kw)
+
+
 def _reductions(spec: ScenarioSpec):
     """Candidate one-knob reductions of ``spec``, most-drastic first
     per knob — yielded as ``(action, reduced_spec)``. Ordering puts
@@ -112,13 +125,18 @@ def _reductions(spec: ScenarioSpec):
         if v > 0:
             yield (f"drop:{knob}",
                    dataclasses.replace(spec, **{knob: 0.0}))
-    for knob in ("swaps", "kills", "scales"):
+    if spec.mut:
+        # a mutant's minimal repro should stand without its lineage
+        # when the parent streams already fail
+        yield "drop:mut", dataclasses.replace(spec, mut=())
+    for knob in ("swaps", "kills", "scales", "announce_restarts",
+                 "forges"):
         v = getattr(spec, knob)
         if v > 0:
-            yield f"zero:{knob}", dataclasses.replace(spec, **{knob: 0})
+            yield f"zero:{knob}", _reduce(spec, **{knob: 0})
             if v > 1:
                 yield (f"halve:{knob}",
-                       dataclasses.replace(spec, **{knob: v // 2}))
+                       _reduce(spec, **{knob: v // 2}))
     if spec.rounds > 1:
         yield ("halve:rounds",
                dataclasses.replace(spec,
@@ -127,11 +145,14 @@ def _reductions(spec: ScenarioSpec):
         yield ("halve:clients",
                dataclasses.replace(spec,
                                    clients=max(2, spec.clients // 2)))
-    if spec.replicas > (2 if spec.kills > 0 else 1):
-        floor = 2 if spec.kills > 0 else 1
+    floor = 2 if (spec.kills > 0 or spec.announce_restarts > 0) else 1
+    if spec.forges > 0:
+        # the quorum contract: a shrink below 2*forges+2 replicas
+        # would measure a lost pod, not the byzantine defense
+        floor = max(floor, 2 * spec.forges + 2)
+    if spec.replicas > floor:
         yield ("halve:replicas",
-               dataclasses.replace(
-                   spec, replicas=max(floor, spec.replicas // 2)))
+               _reduce(spec, replicas=max(floor, spec.replicas // 2)))
     min_requests = 8 if (spec.swaps or spec.kills or spec.scales) else 1
     if spec.requests > min_requests:
         yield ("halve:requests",
@@ -274,11 +295,16 @@ def run_campaign(campaign_seed: int, budget: int,
         verdict = oracle.run(spec)
         verdicts.append(verdict)
         if progress is not None:
-            tag = ("ok" if verdict.ok
-                   else ",".join(verdict.codes()))
+            tag = (",".join(verdict.codes()) or "ok")
+            if verdict.racy_codes():
+                tag += f" (racy: {','.join(verdict.racy_codes())})"
             progress(f"[{i + 1}/{len(specs)}] {spec.canonical()}"
                      f" -> {tag}")
-        if verdict.ok:
+        # gate on the STABLE codes: a racy-only verdict (latency
+        # property) is reported in its record's ``racy`` key but
+        # neither fails the campaign nor feeds the shrinker — there
+        # is no deterministic repro to shrink toward
+        if not verdict.codes():
             continue
         failure = {"index": i, "verdict": verdict.to_record()}
         if shrink_failures:

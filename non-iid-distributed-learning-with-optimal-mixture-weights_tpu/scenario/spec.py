@@ -32,6 +32,21 @@ Spec string syntax (the ``FaultSpec.parse`` contract)::
 
     seed=7,rounds=3,clients=8,replicas=2,requests=24,faults=0.3,
     chaos=0.2,load=0.5,net=0.1,swaps=1,kills=1,scales=0
+
+ISSUE 18 grows the grammar twice. Two COUNT knobs script the carried
+pod fault classes (emitted in ``canonical()`` only when non-zero, so
+every pre-existing spec string, digest, and committed campaign stays
+bitwise identical): ``announce_restarts=N`` restarts N workers
+mid-announce (each race pinned to one swap ordinal through the
+``net``/``announce_restart`` sub-stream) and ``forges=N`` turns N
+workers into byzantine sync peers serving forged weights under a
+forged version (the ``net``/``forge`` sub-stream draws victims and
+versions). And a MUTATION tail ``mut=STREAM@N[+STREAM@N...]`` re-keys
+exactly one sub-grammar's seed stream per entry
+(``derive_seed(stream_seed, "mut", N)``): the coverage-guided hunter
+perturbs a near-miss scenario along the stream that nearly violated —
+keeping every OTHER stream bitwise intact — instead of redrawing the
+whole scenario.
 """
 
 from __future__ import annotations
@@ -62,6 +77,12 @@ EVENT_KINDS = ("kill", "restart", "swap", "scale_up", "scale_down")
 #: failover walks dispatch more often than requests arrive.
 _HORIZON_PER_REQUEST = 8
 _MIN_HORIZON = 64
+
+#: Sub-grammar streams the mutation tail may re-key. The intra-stream
+#: shape draws ("mode"/"shape"/"classes") stay master-tied on purpose:
+#: a mutant explores the SAME kind of adversity at different timing,
+#: not a different scenario altogether.
+MUT_STREAMS = ("faults", "chaos", "load", "net", "events")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -109,6 +130,9 @@ class ScenarioSpec:
     swaps: int = 0
     kills: int = 0
     scales: int = 0
+    announce_restarts: int = 0
+    forges: int = 0
+    mut: tuple = ()
 
     def __post_init__(self):
         if self.seed < 0:
@@ -124,10 +148,42 @@ class ScenarioSpec:
             if not (np.isfinite(v) and 0.0 <= v <= 1.0):
                 raise ValueError(
                     f"intensity {name}={v} must be in [0, 1]")
-        for name in ("swaps", "kills", "scales"):
+        for name in ("swaps", "kills", "scales", "announce_restarts",
+                     "forges"):
             v = getattr(self, name)
             if not isinstance(v, int) or v < 0:
                 raise ValueError(f"{name}={v!r} must be an int >= 0")
+        mut = tuple((str(s), int(n)) for s, n in self.mut)
+        object.__setattr__(self, "mut", mut)
+        for s, n in mut:
+            if s not in MUT_STREAMS:
+                raise ValueError(
+                    f"mut stream {s!r} must be one of "
+                    f"{'/'.join(MUT_STREAMS)}")
+            if n < 1:
+                raise ValueError(
+                    f"mut attempt {n} for stream {s!r} must be >= 1")
+        if self.announce_restarts > self.swaps:
+            raise ValueError(
+                f"announce_restarts={self.announce_restarts} needs one "
+                f"swap per race (swaps={self.swaps}) — the race IS a "
+                "restart during a version announce")
+        if self.announce_restarts > 0 and self.replicas < 2:
+            raise ValueError(
+                f"announce_restarts={self.announce_restarts} needs "
+                "replicas >= 2 — the restarting victim must have a "
+                "peer to resync from")
+        if self.announce_restarts > self.replicas:
+            raise ValueError(
+                f"announce_restarts={self.announce_restarts} exceeds "
+                f"replicas={self.replicas}: one race per host")
+        if self.forges > 0 and self.replicas < 2 * self.forges + 2:
+            raise ValueError(
+                f"forges={self.forges} needs replicas >= "
+                f"{2 * self.forges + 2}: fingerprint quorum holds only "
+                "while a rejoiner's HONEST peers outnumber forgers by "
+                "a strict majority — fewer replicas measures a lost "
+                "pod, not the defense")
         if self.kills > 0 and self.replicas < 2:
             raise ValueError(
                 f"kills={self.kills} needs replicas >= 2 — with one "
@@ -142,9 +198,14 @@ class ScenarioSpec:
     # -- string grammar ------------------------------------------------
     _FIELDS = ("seed", "rounds", "clients", "replicas", "requests",
                "faults", "chaos", "load", "net", "swaps", "kills",
-               "scales")
+               "scales", "announce_restarts", "forges", "mut")
     _INT_FIELDS = frozenset(("seed", "rounds", "clients", "replicas",
-                             "requests", "swaps", "kills", "scales"))
+                             "requests", "swaps", "kills", "scales",
+                             "announce_restarts", "forges"))
+    #: Fields canonical() emits unconditionally — the pre-ISSUE-18
+    #: string layout, frozen so every committed digest/regression key
+    #: survives the grammar growth byte-for-byte.
+    _ALWAYS_FIELDS = _FIELDS[:12]
 
     @classmethod
     def parse(cls, text: str) -> "ScenarioSpec":
@@ -166,6 +227,9 @@ class ScenarioSpec:
                 raise ValueError(
                     f"unknown scenario spec key {key!r} (expected "
                     f"{'/'.join(cls._FIELDS)})")
+            if key == "mut":
+                kw[key] = cls._parse_mut(token, val)
+                continue
             try:
                 kw[key] = (int(val) if key in cls._INT_FIELDS
                            else float(val))
@@ -174,18 +238,52 @@ class ScenarioSpec:
                     f"scenario spec token {token!r}: {e}") from None
         return cls(**kw)
 
+    @staticmethod
+    def _parse_mut(token: str, val: str) -> tuple:
+        out = []
+        for part in val.split("+"):
+            try:
+                stream, n = part.split("@", 1)
+                out.append((stream.strip(), int(n)))
+            except ValueError:
+                raise ValueError(
+                    f"scenario spec token {token!r}: expected "
+                    "STREAM@N[+STREAM@N...] (e.g. 'mut=events@1')"
+                ) from None
+        return tuple(out)
+
     def canonical(self) -> str:
         """The full round-trippable spec string — every field, fixed
         order, so ``parse(canonical())`` is identity and the string is
-        a stable digest/regression key."""
+        a stable digest/regression key. The ISSUE 18 fields append
+        only when ACTIVE, so every earlier spec's canonical string —
+        and everything keyed on it — is unchanged."""
         parts = []
-        for name in self._FIELDS:
+        for name in self._ALWAYS_FIELDS:
             v = getattr(self, name)
             parts.append(f"{name}={v:g}" if isinstance(v, float)
                          else f"{name}={v}")
+        for name in ("announce_restarts", "forges"):
+            if getattr(self, name):
+                parts.append(f"{name}={getattr(self, name)}")
+        if self.mut:
+            parts.append("mut=" + "+".join(
+                f"{s}@{n}" for s, n in self.mut))
         return ",".join(parts)
 
     # -- sub-grammar derivation ---------------------------------------
+    def _sub_seed(self, label: str) -> int:
+        """The seed of one sub-grammar stream: the plain
+        ``derive_seed`` split, re-keyed once per matching ``mut``
+        entry. With an empty mutation tail this IS the pre-ISSUE-18
+        derivation — same integer, same stream, bitwise — and a
+        mutant's OTHER streams stay on their parent's seeds."""
+        s = derive_seed(self.seed, label)
+        for stream, n in self.mut:
+            if stream == label:
+                s = derive_seed(s, "mut", n)
+        return s
+
     def fault_spec(self) -> FaultSpec:
         """The train-leg fault grammar at this intensity. Rates sum to
         ``0.85 * faults`` — under the FaultPlan precedence budget at
@@ -199,7 +297,7 @@ class ScenarioSpec:
             corrupt=round(0.20 * self.faults, 6), corrupt_mode=mode,
             corrupt_scale=25.0,
             lie=round(0.15 * self.faults, 6), lie_frac=0.2,
-            seed=derive_seed(self.seed, "faults"))
+            seed=self._sub_seed("faults"))
 
     def chaos_spec(self) -> ChaosSpec:
         """Replica-boundary chaos at this intensity. ``kill`` stays 0
@@ -211,7 +309,7 @@ class ScenarioSpec:
             wedge=round(0.15 * self.chaos, 6), wedge_s=0.05,
             flaky=round(0.25 * self.chaos, 6),
             slow=round(0.20 * self.chaos, 6), slow_mult=2.0,
-            seed=derive_seed(self.seed, "chaos"))
+            seed=self._sub_seed("chaos"))
 
     def load_spec(self) -> LoadSpec:
         """Arrival schedule: shape drawn from the sub-seeded stream,
@@ -223,18 +321,42 @@ class ScenarioSpec:
             shape=shape, base_rps=base,
             peak_rps=base * (1.0 + 19.0 * self.load),
             duration_s=2.0, at=0.4, width=0.2,
-            seed=derive_seed(self.seed, "load"))
+            seed=self._sub_seed("load"))
 
     def net_spec(self) -> NetChaosSpec:
         """Wire faults at this intensity. ``kill_host`` stays empty —
         process kills are scenario EVENTS (submit-indexed, restartable)
         rather than dispatch-indexed scripted deaths, so one schedule
-        drives them wherever retries move the dispatch counter."""
+        drives them wherever retries move the dispatch counter.
+
+        The ISSUE 18 fault classes ride here: ``announce_restarts``
+        races distinct victim hosts against distinct swap ordinals
+        (race j targets ordinal j — validation guarantees a swap per
+        race), ``forges`` turns distinct hosts byzantine under forged
+        versions drawn far above any honest announce (100..199, so a
+        pre-fix rejoiner's newest-wins rule reliably prefers the
+        forgery). Both draw from their OWN ``net`` sub-streams — a
+        spec without them derives the same NetChaosSpec it always
+        did."""
+        announce, forged = (), ()
+        if self.announce_restarts:
+            rng = derive_rng(self.seed, "net", "announce_restart")
+            hosts = rng.permutation(self.replicas)
+            announce = tuple(
+                (int(hosts[j]), j)
+                for j in range(self.announce_restarts))
+        if self.forges:
+            rng = derive_rng(self.seed, "net", "forge")
+            hosts = rng.permutation(self.replicas)
+            forged = tuple(
+                (int(hosts[j]), int(100 + rng.randint(100)))
+                for j in range(self.forges))
         return NetChaosSpec(
             partition=round(0.08 * self.net, 6), partition_s=0.05,
             refuse=round(0.15 * self.net, 6),
             lag=round(0.15 * self.net, 6), lag_s=0.005,
-            seed=derive_seed(self.seed, "net"))
+            restart_during_announce=announce, forge_sync=forged,
+            seed=self._sub_seed("net"))
 
     # -- event schedule -----------------------------------------------
     @property
@@ -252,7 +374,7 @@ class ScenarioSpec:
         scale events across the middle, each jittered by the events
         sub-stream — different masters move them, one master never
         does."""
-        rng = derive_rng(self.seed, "events")
+        rng = np.random.RandomState(self._sub_seed("events"))
         out = []
 
         def place(frac: float) -> int:
@@ -359,6 +481,13 @@ class ScenarioPlan:
         h.update(np.float64(
             [self.net_plan.partition_s, self.net_plan.lag_s]).tobytes())
         h.update(repr(sorted(self.net_plan.kills.items())).encode())
+        if self.net_plan.announce_restarts or self.net_plan.forges:
+            # appended ONLY when the ISSUE 18 fault classes are armed:
+            # every digest hashed before the grammar grew (committed
+            # campaigns, regression keys) stays byte-identical
+            h.update(repr((
+                sorted(self.net_plan.announce_restarts.items()),
+                sorted(self.net_plan.forges.items()))).encode())
         h.update(np.ascontiguousarray(self.gaps).tobytes())
         h.update(",".join(self.classes).encode())
         h.update(repr([(e.at, e.kind, e.arg)

@@ -54,9 +54,10 @@ from .service import (DeadlineExceeded, Overloaded, ServiceStopped,
                       ServingService)
 from .transport import (DispatchTransport, FrameError,
                         InProcessTransport, PodClientEngine, PodWorker,
-                        SocketTransport, TransportError,
+                        SocketTransport, SyncTimeout, TransportError,
                         TransportRefused, TransportTimeout,
-                        pack_weights, unpack_weights, worker_main)
+                        pack_weights, unpack_weights,
+                        weights_fingerprint, worker_main)
 
 __all__ = [
     "AdmissionController",
@@ -98,6 +99,7 @@ __all__ = [
     "ServingEngine",
     "ServingService",
     "SocketTransport",
+    "SyncTimeout",
     "TransportError",
     "TransportRefused",
     "TransportTimeout",
@@ -123,5 +125,6 @@ __all__ = [
     "split_key",
     "split_results",
     "unpack_weights",
+    "weights_fingerprint",
     "worker_main",
 ]

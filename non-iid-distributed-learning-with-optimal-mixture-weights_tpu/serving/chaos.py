@@ -438,14 +438,30 @@ class NetChaosSpec:
       hook — the one network fault that is also a host fault, placed
       exactly so the pod bench kills the same worker mid-stream every
       run.
+    - **restart_during_announce**: scripted mid-announce rejoin race
+      (ISSUE 18) — host H is down when version announce S starts and
+      comes back WHILE the announce is still walking the pod, the
+      exact window where a resync from a not-yet-announced peer
+      re-opens the version gap. Consumed by the scenario oracle (the
+      announce is an event, not a dispatch, so it cannot live in the
+      per-dispatch ``roles`` matrix).
+    - **forge_sync**: a byzantine sync peer (ISSUE 18) — host PEER
+      answers rejoin ``sync`` frames with FORGED weights under claimed
+      VERSION (self-consistent fingerprint and all), the serving-plane
+      twin of the Blanchard-style training-side byzantine client. A
+      pod whose sync protocol trusts "newest version wins" adopts it;
+      the epoch-fenced, fingerprint-quorum protocol must not.
 
     Spec string syntax (mirrors the ``ChaosSpec`` grammar; MS values
     are milliseconds)::
 
         partition=0.02:250,refuse=0.05,lag=0.1:20,kill_host=1@12,seed=7
                   ^rate ^stall_ms      ^rate ^ms   ^host ^dispatch
+        restart_during_announce=0@1,forge_sync=2@120
+                                ^host ^announce    ^peer ^version
 
-    ``kill_host`` may repeat (one token per victim).
+    ``kill_host``, ``restart_during_announce`` and ``forge_sync`` may
+    repeat (one token per victim/peer).
     """
 
     partition: float = 0.0
@@ -454,6 +470,8 @@ class NetChaosSpec:
     lag: float = 0.0
     lag_s: float = 0.02
     kill_host: tuple = ()
+    restart_during_announce: tuple = ()
+    forge_sync: tuple = ()
     seed: int = 0
 
     def __post_init__(self):
@@ -488,13 +506,43 @@ class NetChaosSpec:
                 "kill_host names one kill per host (a process dies "
                 "once)")
         object.__setattr__(self, "kill_host", kills)
+        # normalize + validate the announce-race schedule: ((host,
+        # announce_ordinal)...) — one race per host, like kills
+        races = tuple((int(h), int(s))
+                      for h, s in self.restart_during_announce)
+        for h, s in races:
+            if h < 0 or s < 0:
+                raise ValueError(
+                    f"restart_during_announce {h}@{s}: host and "
+                    "announce ordinal must be >= 0")
+        if len({h for h, _ in races}) != len(races):
+            raise ValueError(
+                "restart_during_announce names one race per host (a "
+                "host rejoins mid-announce once)")
+        object.__setattr__(self, "restart_during_announce", races)
+        # normalize + validate the byzantine peers: ((host, version)..)
+        forges = tuple((int(h), int(v)) for h, v in self.forge_sync)
+        for h, v in forges:
+            if h < 0:
+                raise ValueError(
+                    f"forge_sync {h}@{v}: peer index must be >= 0")
+            if v < 1:
+                raise ValueError(
+                    f"forge_sync {h}@{v}: the forged version must be "
+                    ">= 1 (a forge claiming v0 is indistinguishable "
+                    "from a fresh worker and tests nothing)")
+        if len({h for h, _ in forges}) != len(forges):
+            raise ValueError(
+                "forge_sync names one forged version per peer")
+        object.__setattr__(self, "forge_sync", forges)
 
     @classmethod
     def parse(cls, text: str) -> "NetChaosSpec":
         """Parse the spec syntax (class docstring). Unknown keys and
         malformed values raise ``ValueError`` naming the token — the
         ``ChaosSpec.parse`` contract on the network axis."""
-        kw: dict = {"kill_host": []}
+        kw: dict = {"kill_host": [], "restart_during_announce": [],
+                    "forge_sync": []}
         for token in text.split(","):
             token = token.strip()
             if not token:
@@ -527,16 +575,33 @@ class NetChaosSpec:
                         raise ValueError(
                             "expected HOST@DISPATCH (e.g. 1@12)")
                     kw["kill_host"].append((int(host), int(disp)))
+                elif key == "restart_during_announce":
+                    host, sep, ann = val.partition("@")
+                    if not sep:
+                        raise ValueError(
+                            "expected HOST@ANNOUNCE (e.g. 0@1)")
+                    kw["restart_during_announce"].append(
+                        (int(host), int(ann)))
+                elif key == "forge_sync":
+                    peer, sep, ver = val.partition("@")
+                    if not sep:
+                        raise ValueError(
+                            "expected PEER@VERSION (e.g. 2@120)")
+                    kw["forge_sync"].append((int(peer), int(ver)))
                 else:
                     raise ValueError(
                         f"unknown net chaos spec key {key!r} (expected "
-                        "partition/refuse/lag/kill_host/seed)")
+                        "partition/refuse/lag/kill_host/"
+                        "restart_during_announce/forge_sync/seed)")
             except ValueError as e:
                 if "unknown net chaos spec key" in str(e):
                     raise
                 raise ValueError(
                     f"net chaos spec token {token!r}: {e}") from None
         kw["kill_host"] = tuple(kw["kill_host"])
+        kw["restart_during_announce"] = tuple(
+            kw["restart_during_announce"])
+        kw["forge_sync"] = tuple(kw["forge_sync"])
         return cls(**kw)
 
 
@@ -551,7 +616,9 @@ class NetChaosPlan:
     identical plan, bitwise. Dispatches past the horizon are clean."""
 
     def __init__(self, roles, partition_s: float = 0.25,
-                 lag_s: float = 0.02, kills: dict | None = None):
+                 lag_s: float = 0.02, kills: dict | None = None,
+                 announce_restarts: dict | None = None,
+                 forges: dict | None = None):
         roles = np.asarray(roles, np.int8)
         if roles.ndim != 2:
             raise ValueError(
@@ -584,6 +651,27 @@ class NetChaosPlan:
                     f"kill_host {h}@{k}: dispatch index must be >= 0 "
                     "(the transport fires at k >= kill_at, so a "
                     "negative index would kill on the FIRST dispatch)")
+        self.announce_restarts = {int(h): int(s) for h, s in
+                                  (announce_restarts or {}).items()}
+        for h, s in self.announce_restarts.items():
+            if not 0 <= h < self.n_hosts:
+                raise ValueError(
+                    f"restart_during_announce host {h} out of range "
+                    f"for a {self.n_hosts}-host plan")
+            if s < 0:
+                raise ValueError(
+                    f"restart_during_announce {h}@{s}: announce "
+                    "ordinal must be >= 0")
+        self.forges = {int(h): int(v)
+                       for h, v in (forges or {}).items()}
+        for h, v in self.forges.items():
+            if not 0 <= h < self.n_hosts:
+                raise ValueError(
+                    f"forge_sync peer {h} out of range for a "
+                    f"{self.n_hosts}-host plan")
+            if v < 1:
+                raise ValueError(
+                    f"forge_sync {h}@{v}: forged version must be >= 1")
 
     @classmethod
     def build(cls, spec: NetChaosSpec, n_hosts: int,
@@ -604,18 +692,24 @@ class NetChaosPlan:
         roles[p], roles[r], roles[lg] = (NET_PARTITION, NET_REFUSE,
                                          NET_LAG)
         return cls(roles, partition_s=spec.partition_s,
-                   lag_s=spec.lag_s, kills=dict(spec.kill_host))
+                   lag_s=spec.lag_s, kills=dict(spec.kill_host),
+                   announce_restarts=dict(spec.restart_during_announce),
+                   forges=dict(spec.forge_sync))
 
     @classmethod
     def scripted(cls, n_hosts: int, partitions: dict | None = None,
                  refuses: dict | None = None, lags: dict | None = None,
                  kills: dict | None = None, horizon: int | None = None,
                  partition_s: float = 0.25,
-                 lag_s: float = 0.02) -> "NetChaosPlan":
+                 lag_s: float = 0.02,
+                 announce_restarts: dict | None = None,
+                 forges: dict | None = None) -> "NetChaosPlan":
         """Exact-placement construction (the pod bench's spelling):
         ``partitions``/``refuses``/``lags`` map host -> an iterable of
         dispatch indices; ``kills`` maps host -> the single dispatch
-        its process dies at."""
+        its process dies at; ``announce_restarts`` maps host -> the
+        announce ordinal it rejoins mid-flight at; ``forges`` maps
+        peer -> the version its sync replies forge."""
         cells = []
         for role, spec_map in ((NET_PARTITION, partitions),
                                (NET_REFUSE, refuses), (NET_LAG, lags)):
@@ -647,7 +741,8 @@ class NetChaosPlan:
                     "are mutually exclusive per cell")
             roles[host, i] = role
         return cls(roles, partition_s=partition_s, lag_s=lag_s,
-                   kills=kills)
+                   kills=kills, announce_restarts=announce_restarts,
+                   forges=forges)
 
     def role(self, host: int, dispatch: int) -> int:
         """The role code of one dispatch (clean past the horizon)."""
@@ -660,6 +755,17 @@ class NetChaosPlan:
         None — plan facts, known before anything runs."""
         return self.kills.get(int(host))
 
+    def announce_restart_at(self, host: int) -> int | None:
+        """The announce ordinal ``host`` rejoins mid-flight at, or
+        None (plan facts — the scenario oracle consumes this at its
+        swap events)."""
+        return self.announce_restarts.get(int(host))
+
+    def forge_at(self, host: int) -> int | None:
+        """The version ``host``'s sync replies forge, or None for an
+        honest peer."""
+        return self.forges.get(int(host))
+
     def counts(self) -> dict:
         """Planned fault totals over the whole horizon — what the pod
         bench records beside what actually FIRED."""
@@ -668,6 +774,8 @@ class NetChaosPlan:
             "refuse": int(np.sum(self.roles == NET_REFUSE)),
             "lag": int(np.sum(self.roles == NET_LAG)),
             "kills": len(self.kills),
+            "announce_restarts": len(self.announce_restarts),
+            "forges": len(self.forges),
         }
 
 

@@ -97,6 +97,7 @@ them.
 
 from __future__ import annotations
 
+import hashlib
 import io
 import json
 import os
@@ -150,6 +151,16 @@ class TransportTimeout(TransportError):
     a wedged worker) or its deadline budget was exhausted before any
     I/O. The connection is dropped — a half-open exchange must never
     leak a stale response into the NEXT dispatch's read."""
+
+
+class SyncTimeout(TransportTimeout):
+    """A rejoin ``sync`` peer accepted the connection but never
+    answered within its bounded budget — the wedged (dead-but-
+    accepting) peer. Typed so the resync loop can COUNT it and move to
+    the next peer instead of letting one wedged process stall a
+    rejoining worker's pre-serve handshake indefinitely (ISSUE 18
+    satellite: the rejoin path must come up in bounded time whatever
+    one peer does)."""
 
 
 class FrameError(ValueError):
@@ -295,6 +306,33 @@ def unpack_weights(blob: bytes) -> tuple:
     if not params:
         raise FrameError("weight payload carries no parameters")
     return params, rff
+
+
+def weights_fingerprint(params: dict, rff=None,
+                        version: int = 0) -> str:
+    """Content fingerprint of one weight set under one version, the
+    sync/announce-frame analogue of the PR 9 artifact
+    ``host_fingerprint``: sha256 over the version number plus every
+    array's name, dtype, shape, and raw bytes, in sorted name order.
+
+    Computed over CONTENT, never over the npz blob — ``np.savez``
+    embeds zip member timestamps, so byte-hashing the blob would make
+    the same weights fingerprint differently across packings. Two
+    workers serving the same weights under the same version agree on
+    this string whatever process packed the frame; a byzantine peer
+    serving forged weights under a stolen version cannot match an
+    honest quorum's fingerprint without the honest bytes."""
+    h = hashlib.sha256()
+    h.update(f"v{int(version)}".encode())
+    arrays = {f"p:{k}": np.asarray(v) for k, v in params.items()}
+    if rff is not None:
+        arrays["r:W"] = np.asarray(rff[0])
+        arrays["r:b"] = np.asarray(rff[1])
+    for name in sorted(arrays):
+        a = np.ascontiguousarray(arrays[name])
+        h.update(f"|{name}:{a.dtype.str}:{a.shape}|".encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
 
 
 # ---------------------------------------------------------------------
@@ -616,6 +654,12 @@ class PodClientEngine:
         self.max_frame_bytes = int(max_frame_bytes)
         self._timings: dict | None = None
         self.last_announce: dict | None = None
+        #: optional per-endpoint announce observer, called as
+        #: ``on_announce(endpoint, ok)`` after EACH announce attempt
+        #: (inside the swap critical section). The scenario oracle
+        #: uses it to script the mid-announce rejoin race — a worker
+        #: restarting between two attempts of ONE announce.
+        self.on_announce = None
         errs = []
         meta = None
         for ep in self.endpoints:
@@ -632,6 +676,11 @@ class PodClientEngine:
         self.input_dim = int(meta["input_dim"])
         self.num_classes = int(meta["num_classes"])
         self._version = int(meta["version"])
+        # announce epoch fence (ISSUE 18): a fresh client joins at the
+        # pod's last-seen epoch so its first announce outranks every
+        # announce the pod already heard; absent in a pre-epoch
+        # worker's hello -> 0, byte-compatible both ways
+        self._epoch = int(meta.get("epoch", 0))
         self._vlock = threading.Lock()
         # serializes whole announces (pick -> broadcast -> commit):
         # two concurrent swaps racing into one version number would
@@ -704,7 +753,20 @@ class PodClientEngine:
         the announce gap without operator re-feeding, ISSUE 16).
         Raises :class:`TransportError` when NO worker
         acked — an announce nobody heard must not bump the client's
-        notion of live."""
+        notion of live.
+
+        ISSUE 18 hardening, byte-compatible on clean paths: the
+        announce header carries a MONOTONIC EPOCH (one per announce,
+        fenced worker-side — a replayed or out-of-order announce is
+        refused loudly) and the :func:`weights_fingerprint` of the
+        announced content (a worker verifies the unpacked bytes match
+        before installing). After a first pass with at least one ack,
+        failed endpoints get ONE straggler re-pass: a worker that
+        restarted mid-announce (the ``restart_during_announce`` race)
+        is back by then and either installs the version or refuses it
+        as stale because its rejoin sync already delivered it —
+        either way the pod converges on one version without waiting
+        for the next announce."""
         if params is None:
             raise ValueError(
                 "pod swap_weights needs params (flip-only version= "
@@ -723,27 +785,56 @@ class PodClientEngine:
             with self._vlock:
                 v = (self._version + 1 if version is None
                      else int(version))
+            epoch = getattr(self, "_epoch", 0) + 1
             blob = pack_weights(params, rff)
-            acks, failures = 0, []
+            header = {"kind": "swap", "version": v, "epoch": epoch,
+                      "fingerprint": weights_fingerprint(params, rff,
+                                                         v)}
+            hook = getattr(self, "on_announce", None)
+            acks, failed = 0, []
             for ep in self.endpoints:
+                ok = False
                 try:
-                    resp, _ = self.control(
-                        ep, {"kind": "swap", "version": v}, blob)
+                    resp, _ = self.control(ep, header, blob)
                 except (TransportError, FrameError, OSError) as e:
-                    failures.append(f"{ep}: {e}")
-                    continue
-                if resp.get("kind") == "ok":
-                    acks += 1
+                    failed.append((ep, f"{ep}: {e}"))
                 else:
-                    failures.append(f"{ep}: {resp.get('error')}")
+                    if resp.get("kind") == "ok":
+                        acks += 1
+                        ok = True
+                    else:
+                        failed.append((ep,
+                                       f"{ep}: {resp.get('error')}"))
+                if hook is not None:
+                    hook(ep, ok)
             if not acks:
                 raise TransportError(
                     f"version announce v{v} reached no worker: "
-                    + "; ".join(failures))
+                    + "; ".join(msg for _, msg in failed))
+            if failed:
+                # the straggler re-pass (never when NOBODY acked: a
+                # fully dark pod is the caller's error above). One
+                # bounded retry per first-pass failure; a still-dead
+                # endpoint keeps its original failure entry
+                still = []
+                for ep, msg in failed:
+                    try:
+                        resp, _ = self.control(ep, header, blob)
+                    except (TransportError, FrameError, OSError):
+                        still.append((ep, msg))
+                        continue
+                    if resp.get("kind") == "ok":
+                        acks += 1
+                    else:
+                        still.append((ep,
+                                      f"{ep}: {resp.get('error')}"))
+                failed = still
             with self._vlock:
                 self._version = v
+            self._epoch = epoch
             self.last_announce = {"version": v, "acks": acks,
-                                  "failures": failures}
+                                  "failures": [msg for _, msg
+                                               in failed]}
             return v
         finally:
             self._swap_lock.release()
@@ -770,7 +861,8 @@ class PodWorker:
 
     def __init__(self, engine, host: str = "127.0.0.1", port: int = 0,
                  worker_id: int = 0, tracer=None,
-                 max_frame_bytes: int = MAX_FRAME_BYTES, peers=None):
+                 max_frame_bytes: int = MAX_FRAME_BYTES, peers=None,
+                 forge_sync=None):
         """``peers`` (ISSUE 16, the announce-gap fix): pod endpoints
         this worker re-requests the agreed weight version from on
         :meth:`start`. A worker rejoining after SIGKILL restarts from
@@ -780,11 +872,27 @@ class PodWorker:
         the pod's name until an operator re-feeds it. With peers set,
         ``start`` syncs BEFORE accepting connections: the worker asks
         each peer (``sync`` frame), installs the newest version found,
-        and only then serves."""
+        and only then serves.
+
+        ``forge_sync`` (ISSUE 18, test-only byzantine mode): when set
+        to an integer version, this worker answers ``sync`` requests
+        with FORGED weights — same-shape garbage drawn from a PRNG
+        keyed on the forged version, claimed under that version. The
+        scenario fuzzer uses it to model a byzantine sync peer; honest
+        deployments never set it."""
         self.engine = engine
         self.worker_id = int(worker_id)
         self.peers = [(str(h), int(p)) for h, p in (peers or [])]
+        self.forge_sync = None if forge_sync is None else int(forge_sync)
         self.resyncs = 0
+        self.sync_timeouts = 0
+        self.stale_refused = 0
+        self.forge_rejected = 0
+        # the announce fence (ISSUE 18): highest announce epoch this
+        # worker has accepted (or adopted via rejoin sync), and the
+        # content fingerprint it installed under it
+        self._epoch = 0
+        self._last_fingerprint = None
         self.tracer = tracer if tracer is not None else get_tracer()
         self.max_frame_bytes = int(max_frame_bytes)
         # capability check once, like ServingService does: whether the
@@ -855,6 +963,27 @@ class PodWorker:
     def __exit__(self, *exc):
         self.stop()
 
+    def _sync_one(self, ep, timeout_s: float) -> tuple:
+        """One peer's ``sync`` exchange on its own short-lived
+        connection, bounded by ``timeout_s``. A peer that accepted the
+        connection but never answers within the budget — the wedged
+        dead-but-accepting process — raises :class:`SyncTimeout` so
+        the resync loop can COUNT it and move on instead of stalling
+        the rejoiner's pre-serve handshake behind one bad peer."""
+        try:
+            with socket.create_connection(ep, timeout=timeout_s) as sock:
+                sock.settimeout(timeout_s)
+                write_frame(sock, {"kind": "sync"})
+                return read_frame(sock, self.max_frame_bytes)
+        except socket.timeout as e:
+            raise SyncTimeout(
+                f"sync peer {ep[0]}:{ep[1]} accepted but never "
+                f"answered within {timeout_s:.1f}s") from e
+        except TransportTimeout as e:
+            raise SyncTimeout(
+                f"sync peer {ep[0]}:{ep[1]} timed out mid-frame: "
+                f"{e}") from e
+
     def resync(self, timeout_s: float = 5.0) -> int | None:
         """Re-request the pod's agreed weight version from ``peers``.
 
@@ -865,31 +994,90 @@ class PodWorker:
         two versions and joining the older side would re-open the gap
         one announce later. Unreachable or weightless peers are
         skipped: a lone survivor restarting a dead pod has nobody to
-        ask and must still come up. Returns the installed version, or
-        None when nothing newer was found."""
-        best_v, best_payload = None, b""
+        ask and must still come up.
+
+        ``timeout_s`` is the TOTAL handshake budget, not a per-peer
+        one: each peer gets at most the budget's remainder, a wedged
+        peer raises (and counts) :class:`SyncTimeout` instead of
+        hanging, and a spent budget ends the loop — the rejoiner comes
+        up in bounded time whatever its peers do.
+
+        Byzantine hardening (ISSUE 18), in trust order: a reply
+        carrying a ``fingerprint`` that does not hash its own payload
+        is dropped outright (a corrupt or lazily-forged peer); then,
+        when a strict majority of the fingerprinted replies agree on
+        one fingerprint, every disagreeing fingerprinted reply is
+        dropped too — a self-consistent forger hashes its own garbage
+        correctly, so only quorum unmasks it. Without a strict
+        majority (two honest peers mid-announce legitimately disagree)
+        nothing is dropped and the newest ``(version, epoch)`` wins as
+        before. Legacy replies without fingerprints never enter the
+        quorum. Returns the installed version, or None when nothing
+        newer was found."""
         my_v = int(getattr(self.engine, "version", 0))
+        deadline = time.monotonic() + float(timeout_s)
+        replies = []  # (version, epoch, fingerprint|None, payload)
         for ep in self.peers:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break  # budget spent: serve with the best found so far
             try:
-                with socket.create_connection(
-                        ep, timeout=timeout_s) as sock:
-                    sock.settimeout(timeout_s)
-                    write_frame(sock, {"kind": "sync"})
-                    resp, payload = read_frame(sock,
-                                               self.max_frame_bytes)
+                resp, payload = self._sync_one(ep, remaining)
+            except SyncTimeout:
+                with self._lock:
+                    self.sync_timeouts += 1
+                continue  # wedged peer: ask the next one
             except (TransportError, FrameError, OSError):
                 continue  # dead/refusing peer: ask the next one
             if resp.get("kind") != "weights":
                 continue  # peer hosts nothing exportable
             v = int(resp.get("version", 0))
-            if v > my_v and (best_v is None or v > best_v):
-                best_v, best_payload = v, payload
-        if best_v is None:
+            epoch = int(resp.get("epoch", 0))
+            fp = resp.get("fingerprint")
+            if fp is not None:
+                params, rff = unpack_weights(payload)
+                if weights_fingerprint(params, rff, v) != str(fp):
+                    # the reply disowns its own payload: corrupt wire
+                    # or a forger too lazy to re-hash — drop it loudly
+                    with self._lock:
+                        self.forge_rejected += 1
+                    continue
+                fp = str(fp)
+            replies.append((v, epoch, fp, payload))
+        fingerprinted = [r for r in replies if r[2] is not None]
+        if fingerprinted:
+            tally = {}
+            for _, _, fp, _ in fingerprinted:
+                tally[fp] = tally.get(fp, 0) + 1
+            top_fp = max(tally, key=lambda k: (tally[k], k))
+            if tally[top_fp] * 2 > len(fingerprinted):
+                # strict majority: the pod agrees on one content hash,
+                # so a self-consistent minority reply is a forgery
+                # (or hopelessly stale) — reject, count, move on
+                rejected = [r for r in fingerprinted if r[2] != top_fp]
+                if rejected:
+                    with self._lock:
+                        self.forge_rejected += len(rejected)
+                replies = [r for r in replies
+                           if r[2] is None or r[2] == top_fp]
+        best = None
+        for v, epoch, _, payload in replies:
+            if v <= my_v:
+                continue
+            if best is None or (v, epoch) > (best[0], best[1]):
+                best = (v, epoch, payload)
+        if best is None:
             return None
+        best_v, best_epoch, best_payload = best
         params, rff = unpack_weights(best_payload)
         v = self.engine.swap_weights(params, rff=rff, version=best_v)
         with self._lock:
             self.resyncs += 1
+            if best_epoch > self._epoch:
+                # adopt the pod's announce epoch: the fence must hold
+                # across a rejoin, or the next stale announce would
+                # look fresh to this worker
+                self._epoch = best_epoch
         return int(v)
 
     def _accept_loop(self) -> None:
@@ -977,9 +1165,17 @@ class PodWorker:
             swaps = self.swaps
             errors = self.errors
             resyncs = self.resyncs
+            sync_timeouts = self.sync_timeouts
+            stale_refused = self.stale_refused
+            forge_rejected = self.forge_rejected
+            epoch = self._epoch
         return {
             "kind": "meta", "worker": self.worker_id,
+            "epoch": epoch,
             "resyncs": resyncs,
+            "sync_timeouts": sync_timeouts,
+            "stale_refused": stale_refused,
+            "forge_rejected": forge_rejected,
             "buckets": [int(b) for b in self.engine.buckets],
             "input_dim": int(self.engine.input_dim),
             "num_classes": int(self.engine.num_classes),
@@ -1009,15 +1205,56 @@ class PodWorker:
         weights under the ANNOUNCED version number and make them live
         — every worker of the pod lands on the same number, so
         post-swap dispatches report one agreed ``model_version``
-        whichever worker serves them."""
+        whichever worker serves them.
+
+        Hardened (ISSUE 18), optional-field byte-compatible: an
+        announce carrying an ``epoch`` at or below the last accepted
+        one is REFUSED loudly (a replayed/stale announce installing
+        old weights over new is exactly the announce-race corruption;
+        the refusal is a permanent typed error, never a silent drop),
+        and an announce carrying a ``fingerprint`` is verified against
+        the unpacked content before anything installs. Frames from a
+        pre-epoch client carry neither field and behave as before."""
         version = header.get("version")
         if not isinstance(version, int):
             raise FrameError(
                 f"swap frame needs an integer version, got {version!r}")
+        epoch = header.get("epoch")
+        if epoch is not None:
+            epoch = int(epoch)
+            with self._lock:
+                stale = epoch <= self._epoch
+                if stale:
+                    self.stale_refused += 1
+                    last = self._epoch
+            if stale:
+                return {"kind": "error", "transient": False,
+                        "error": f"stale announce epoch {epoch} "
+                                 f"refused: worker {self.worker_id} "
+                                 f"already accepted epoch {last} — "
+                                 "re-announce from the live client"
+                        }, b""
         params, rff = unpack_weights(payload)
+        claimed = header.get("fingerprint")
+        if claimed is not None:
+            actual = weights_fingerprint(params, rff, version)
+            if actual != str(claimed):
+                with self._lock:
+                    self.forge_rejected += 1
+                return {"kind": "error", "transient": False,
+                        "error": f"announce v{version} fingerprint "
+                                 f"mismatch: header claims "
+                                 f"{str(claimed)[:12]}.., payload "
+                                 f"hashes {actual[:12]}.. — refusing "
+                                 "to install unverifiable weights"
+                        }, b""
         v = self.engine.swap_weights(params, rff=rff, version=version)
         with self._lock:
             self.swaps += 1
+            if epoch is not None:
+                self._epoch = epoch
+            if claimed is not None:
+                self._last_fingerprint = str(claimed)
         return {"kind": "ok", "version": int(v),
                 "worker": self.worker_id}, b""
 
@@ -1026,14 +1263,41 @@ class PodWorker:
         the LIVE weights under their version so the rejoiner lands on
         the pod's agreed state without operator involvement. A worker
         whose engine exports no weight pytree answers its meta instead
-        — the rejoiner skips it and asks the next peer."""
+        — the rejoiner skips it and asks the next peer.
+
+        A worker in ``forge_sync`` byzantine mode (test-only) serves
+        same-shape garbage under the forged version instead: weights
+        drawn from a PRNG keyed on that version, so the forgery is
+        deterministic per scenario and structurally indistinguishable
+        from an honest reply without content verification.
+
+        Hardened replies (ISSUE 18) also carry the announce ``epoch``
+        and a content ``fingerprint`` computed LIVE over the served
+        payload. The forger computes a SELF-CONSISTENT fingerprint
+        over its forged weights — content hashing alone cannot unmask
+        it, which is exactly why :meth:`resync` also runs the
+        strict-majority quorum over fingerprints."""
         params = getattr(self.engine, "params", None)
         if params is None:
             return self._meta(), b""
-        blob = pack_weights(params, getattr(self.engine, "rff", None))
+        rff = getattr(self.engine, "rff", None)
+        version = int(getattr(self.engine, "version", 0))
+        if self.forge_sync is not None:
+            params, version = self._forge_params(params), self.forge_sync
+        blob = pack_weights(params, rff)
+        with self._lock:
+            epoch = self._epoch
         return {"kind": "weights",
-                "version": int(getattr(self.engine, "version", 0)),
+                "version": version,
+                "epoch": epoch,
+                "fingerprint": weights_fingerprint(params, rff, version),
                 "worker": self.worker_id}, blob
+
+    def _forge_params(self, params) -> dict:
+        rng = np.random.RandomState(int(self.forge_sync) % (2 ** 32))
+        return {k: rng.standard_normal(np.shape(v)).astype(
+                    np.asarray(v).dtype)
+                for k, v in params.items()}
 
     def _handle_dispatch(self, header: dict, payload: bytes) -> tuple:
         budget = header.get("budget_s")

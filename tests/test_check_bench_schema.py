@@ -819,6 +819,93 @@ def test_campaign_rejects_ok_codes_disagreement(tmp_path):
     assert any("red verdict" in e for e in errs2)
 
 
+# -- CAMPAIGN.v2 (ISSUE 18: the coverage-guided hunt artifact) --------
+
+def _hunt_art(**over):
+    base = _campaign_art()["verdicts"]
+    v0 = dict(base[0], origin={"kind": "grid", "index": 3},
+              signature=["faults", "kill"])
+    v1 = dict(base[1], origin={"kind": "mutation", "parent": 0,
+                               "stream": "events", "attempt": 1},
+              signature=["faults", "kill", "mutant"])
+    art = _campaign_art(schema="CAMPAIGN.v2", verdicts=[v0, v1],
+                        coverage={"faults": 2, "kill": 2, "mutant": 1},
+                        wall_budget_s=None)
+    art.update(over)
+    return art
+
+
+def test_campaign_v2_hunt_artifact_validates(tmp_path):
+    assert cbs.validate_file(
+        _write(tmp_path, "CAMPAIGN_x.json", _hunt_art())) == []
+    # a capped hunt records its cap as a positive number
+    assert cbs.validate_file(_write(
+        tmp_path, "CAMPAIGN_x.json", _hunt_art(wall_budget_s=120.5))) \
+        == []
+    # v1 artifacts predate the hunt accounting and stay valid bare
+    assert cbs.validate_file(
+        _write(tmp_path, "CAMPAIGN_x.json", _campaign_art())) == []
+
+
+def test_campaign_v2_requires_hunt_accounting(tmp_path):
+    art = _hunt_art()
+    del art["coverage"]
+    errs = cbs.validate_file(_write(tmp_path, "CAMPAIGN_x.json", art))
+    assert any("'coverage'" in e for e in errs)
+    p = _write(tmp_path, "CAMPAIGN_x.json",
+               _hunt_art(coverage={"faults": -1}))
+    assert any("non-negative" in e for e in cbs.validate_file(p))
+    art = _hunt_art()
+    del art["wall_budget_s"]
+    errs = cbs.validate_file(_write(tmp_path, "CAMPAIGN_x.json", art))
+    assert any("wall_budget_s" in e for e in errs)
+    for bad in (0, -2, "fast"):
+        p = _write(tmp_path, "CAMPAIGN_x.json",
+                   _hunt_art(wall_budget_s=bad))
+        assert any("wall_budget_s" in e for e in cbs.validate_file(p)), \
+            f"accepted wall_budget_s={bad!r}"
+
+
+def test_campaign_v2_requires_verdict_provenance(tmp_path):
+    # a v2 verdict without its origin/signature cannot be replayed
+    art = _hunt_art()
+    del art["verdicts"][0]["origin"]
+    errs = cbs.validate_file(_write(tmp_path, "CAMPAIGN_x.json", art))
+    assert any("'origin'" in e for e in errs)
+    art = _hunt_art()
+    del art["verdicts"][1]["signature"]
+    errs = cbs.validate_file(_write(tmp_path, "CAMPAIGN_x.json", art))
+    assert any("'signature'" in e for e in errs)
+    art = _hunt_art()
+    art["verdicts"][0]["origin"] = {"kind": "wishful"}
+    errs = cbs.validate_file(_write(tmp_path, "CAMPAIGN_x.json", art))
+    assert any("'grid' or 'mutation'" in e for e in errs)
+    art = _hunt_art()
+    art["verdicts"][0]["origin"] = {"kind": "grid"}
+    errs = cbs.validate_file(_write(tmp_path, "CAMPAIGN_x.json", art))
+    assert any("pool 'index'" in e for e in errs)
+
+
+def test_campaign_v2_rejects_ill_founded_mutation_lineage(tmp_path):
+    # a mutant whose parent ran LATER (or is itself) is a lineage the
+    # seed could never re-derive — the hand-edit this gate exists for
+    for parent in (1, 5, -1, None):
+        art = _hunt_art()
+        art["verdicts"][1]["origin"]["parent"] = parent
+        errs = cbs.validate_file(_write(tmp_path, "CAMPAIGN_x.json",
+                                        art))
+        assert any("EARLIER verdict" in e for e in errs), \
+            f"accepted mutation parent={parent!r}"
+    art = _hunt_art()
+    del art["verdicts"][1]["origin"]["stream"]
+    errs = cbs.validate_file(_write(tmp_path, "CAMPAIGN_x.json", art))
+    assert any("'stream'" in e for e in errs)
+    art = _hunt_art()
+    art["verdicts"][1]["origin"]["attempt"] = 0
+    errs = cbs.validate_file(_write(tmp_path, "CAMPAIGN_x.json", art))
+    assert any("'attempt'" in e for e in errs)
+
+
 def test_campaign_rejects_bad_shrink_trace(tmp_path):
     bad_v = dict(_campaign_art()["verdicts"][0],
                  codes=["RECOMPILE"], ok=False)

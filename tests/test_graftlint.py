@@ -679,6 +679,27 @@ def test_gl003_transport_near_miss_stays_silent(tmp_path):
     assert findings == []
 
 
+def test_issue18_byzantine_sync_surface_is_hot(tmp_path):
+    """The ISSUE 18 hot-path extension: announce/sync verification and
+    the rejoin resync run on pod serve threads, the client's announce
+    holds the pod-wide swap lock, and the hunt scheduler's pricing
+    loop is wall-budget-accounted — all named hot, so a host sync
+    there fails the gate."""
+    from tools.graftlint.astscope import HOT_PATHS
+    assert {"PodClientEngine.swap_weights", "PodWorker.resync",
+            "PodWorker._handle_swap", "PodWorker._handle_sync"} \
+        <= HOT_PATHS["serving/transport.py"]
+    assert "run_search" in HOT_PATHS["scenario/search.py"]
+    findings, _ = lint_src(tmp_path, """
+        class PodWorker:
+            def _handle_swap(self, header, payload):
+                out = self.engine.predict(self._decode(payload))
+                out.block_until_ready()
+                return {"kind": "swapped"}, b""
+    """, name="serving/transport.py")
+    assert rules_of(findings) == ["GL003"]
+
+
 # -- GL005: impure traced code ----------------------------------------
 
 def test_gl005_flags_host_rng_and_wallclock_in_traced_code(tmp_path):

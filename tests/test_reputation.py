@@ -34,7 +34,8 @@ from fedamw_tpu.algorithms import FedAMW, FedAvg, FedNova, prepare_setup
 from fedamw_tpu.data import load_dataset
 from fedamw_tpu.fedcore.aggregate import fednova_effective_weights
 from fedamw_tpu.fedcore.faults import FaultPlan
-from fedamw_tpu.fedcore.robust import (REP_DECAY_DEFAULT,
+from fedamw_tpu.fedcore.robust import (KRUM_DESEL_EROSION,
+                                       REP_DECAY_DEFAULT,
                                        REP_FLOOR_DEFAULT, Z_AUTO_MAX,
                                        Z_AUTO_MIN, directional_scores,
                                        parse_robust_spec,
@@ -659,3 +660,78 @@ def test_auto_threshold_trim_is_wired_into_the_round_scan(setup_het):
     assert np.asarray(d["z_threshold"]).max() <= 5.0 + 1e-5
     # and the honest folds still tighten it downward afterwards
     assert d["z_threshold"][-1] < 4.0
+
+
+# -- krum selection as reputation evidence (ISSUE 18) -----------------
+
+def test_reputation_update_krum_channel_math():
+    """The selection channel is exact: a deselected CANDIDATE keeps
+    KRUM_DESEL_EROSION of its evidence, selected candidates and
+    non-candidates are untouched, and omitting the channel is the
+    pre-ISSUE-18 update bitwise."""
+    J = 4
+    ones = np.ones(J, np.float32)
+    good = np.full(J, 0.9, np.float32)
+    sel = np.asarray([1, 0, 0, 1], np.float32)
+    cand = np.asarray([1, 1, 0, 0], np.float32)
+    rep = np.asarray(reputation_update(ones, ones, ones, good, ones,
+                                       None, 3.0, 0.5, sel=sel,
+                                       sel_cand=cand))
+    # client 1: deselected candidate -> evidence 1 - EROSION = 0.5,
+    # rep = 0.5 * 1 + 0.5 * 0.5
+    assert rep[1] == pytest.approx(
+        0.5 + 0.5 * (1.0 - KRUM_DESEL_EROSION), abs=1e-5)
+    # selected candidate (0) and both non-candidates (2, 3) keep full
+    # evidence — deselection only means something to considered clients
+    np.testing.assert_allclose(rep[[0, 2, 3]], 1.0, atol=1e-5)
+    plain = np.asarray(reputation_update(ones, ones, ones, good, ones,
+                                         None, 3.0, 0.5))
+    np.testing.assert_array_equal(
+        np.asarray(reputation_update(ones, ones, ones, good, ones,
+                                     None, 3.0, 0.5, sel=None)), plain)
+
+
+def test_krum_verdicts_feed_reputation_one_round_delayed(setup_iid):
+    """Fixed path e2e: under `rep+mkrum` the aggregator's selection
+    verdict becomes next round's evidence. Round 0 reputation is
+    IDENTICAL to the mkrum-free run (the carry starts with no verdict
+    — the one-round delay), the flipper is deselected every round, and
+    its reputation decays to the floor and stays gated."""
+    R, J = 8, setup_iid.num_clients
+    plan = sign_plan(R, J, 2)
+    with_k = FedAvg(setup_iid, faults=plan,
+                    robust_agg="rep:0.5:0.2+mkrum:7", round=R, **KW)
+    plain = FedAvg(setup_iid, faults=plan, robust_agg="rep:0.5:0.2",
+                   round=R, **KW)
+    dk = with_k["defense"]
+    assert np.all(np.isfinite(with_k["test_loss"]))
+    # the distance selector rejects the sign flip from round 0 on
+    np.testing.assert_array_equal(dk["krum_selected"][:, 2], 0)
+    # one-round delay: round 0's EWMA ran before any verdict existed
+    np.testing.assert_allclose(dk["reputation"][0],
+                               plain["defense"]["reputation"][0],
+                               atol=1e-6)
+    # decay to the floor, honest clients keep near-full trust (mkrum:7
+    # deselects exactly one client — the flipper — so no honest client
+    # ever pays the erosion)
+    assert dk["reputation"][-1, 2] < 0.2
+    assert np.delete(dk["reputation"][-1], 2).min() > 0.5
+    np.testing.assert_array_equal(
+        np.delete(dk["krum_selected"], 2, axis=1), 1)
+
+
+def test_krum_verdicts_feed_reputation_on_learned_path(setup_iid):
+    """Learned path e2e: FedAMW's present-mask krum fold records the
+    same verdict stream — the flipper is deselected, its reputation
+    decays below the floor, and its learned mixture mass is exactly
+    zero (selection AND the rep gate both fold into the mask the
+    p-solve sees)."""
+    R, J = 8, setup_iid.num_clients
+    res = FedAMW(setup_iid, faults=sign_plan(R, J, 2),
+                 robust_agg="rep:0.5:0.2+mkrum:7", lambda_reg=1e-4,
+                 lr_p=1e-3, return_state=True, round=R, **KW)
+    d = res["defense"]
+    assert np.all(np.isfinite(res["test_loss"]))
+    np.testing.assert_array_equal(d["krum_selected"][:, 2], 0)
+    assert d["reputation"][-1, 2] < 0.2
+    assert float(np.asarray(res["p"])[2]) == 0.0

@@ -9,8 +9,13 @@ the oracle's clean verdict on live serve legs, every injectable
 invariant break caught AND shrunk to a still-failing minimum, the
 announce-gap regression story (resync disabled fails, the shipped fix
 passes), and the campaign artifact's same-seed determinism modulo
-wall-clock. The >=200-scenario sweep is the slow-marked
-``campaign_sweep`` nightly at the bottom.
+wall-clock. ISSUE 18 adds the hunter's pins: the byzantine grammar
+growth (``announce_restarts``/``forges``/``mut`` — token-compatible
+with every pre-growth canonical string), targeted mutation re-keying,
+the hunt pool, signatures and near-miss detection, ``run_search``
+determinism, and the committed ``CAMPAIGN_r18.json`` re-derivation.
+The >=200-scenario sweep is the slow-marked ``campaign_sweep``
+nightly at the bottom.
 """
 
 import dataclasses
@@ -25,9 +30,14 @@ import pytest
 from fedamw_tpu.scenario import (INVARIANTS, OracleEngine,
                                  PropertyOracle, ScenarioEvent,
                                  ScenarioSpec, Verdict, Violation,
-                                 load_regression, run_campaign, shrink,
-                                 write_regression)
+                                 load_regression, run_campaign,
+                                 run_search, shrink, write_regression)
 from fedamw_tpu.scenario.campaign import campaign_digest, scenario_grid
+from fedamw_tpu.scenario.search import (COVERAGE_AXES,
+                                        actual_signature, hunt_grid,
+                                        near_miss_streams,
+                                        predicted_signature,
+                                        search_digest)
 from fedamw_tpu.serving.transport import PodWorker
 from fedamw_tpu.utils.seeds import derive_rng, derive_seed
 
@@ -349,19 +359,182 @@ def test_committed_campaign_artifact_matches_regeneration():
     assert art["verdicts"] == committed["verdicts"]
 
 
+# -- the byzantine grammar growth (ISSUE 18) ---------------------------
+
+def test_byzantine_knobs_roundtrip_and_stay_token_compatible():
+    # the grammar growth: announce_restarts / forges / mut spell
+    # canonically and re-parse bitwise...
+    text = ("seed=7,rounds=2,clients=4,replicas=6,requests=16,"
+            "faults=0.3,chaos=0,load=0,net=0.1,swaps=2,kills=1,"
+            "scales=0,announce_restarts=1,forges=2,mut=events@1+net@2")
+    spec = ScenarioSpec.parse(text)
+    assert spec.canonical() == text
+    assert ScenarioSpec.parse(spec.canonical()) == spec
+    assert spec.mut == (("events", 1), ("net", 2))
+    # ...and a spec that never arms them emits NO new tokens — every
+    # pre-ISSUE-18 canonical string (committed regressions included)
+    # survives the growth byte-for-byte
+    plain = ScenarioSpec(seed=7, replicas=2, requests=16, swaps=1,
+                         kills=1)
+    for token in ("announce_restarts", "forges", "mut"):
+        assert token not in plain.canonical()
+    assert ScenarioSpec.parse(plain.canonical()) == plain
+
+
+def test_byzantine_knobs_reject_unsatisfiable_scenarios():
+    with pytest.raises(ValueError, match="needs one"):
+        ScenarioSpec(announce_restarts=1)  # no announce to race
+    with pytest.raises(ValueError, match="replicas >= 6"):
+        # the fingerprint-quorum floor: 2 forgers need 2*2+2 hosts
+        ScenarioSpec(replicas=4, forges=2, kills=1, requests=16)
+    with pytest.raises(ValueError, match="must be one of"):
+        ScenarioSpec(mut=(("bogus", 1),))
+    with pytest.raises(ValueError, match=">= 1"):
+        ScenarioSpec(mut=(("events", 0),))
+    with pytest.raises(ValueError, match="STREAM@N"):
+        ScenarioSpec.parse("seed=1,mut=events")
+
+
+def test_mutation_tail_rekeys_only_its_stream():
+    # mut=STREAM@N is a targeted re-key: the named sub-grammar's seed
+    # moves, every other stream stays bitwise
+    base = ScenarioSpec(seed=1729, replicas=2, requests=16, swaps=1,
+                        kills=1, faults=0.3, chaos=0.2, load=0.5,
+                        net=0.1)
+    mutant = dataclasses.replace(base, mut=(("faults", 1),))
+    assert mutant.fault_spec().seed != base.fault_spec().seed
+    assert mutant.chaos_spec() == base.chaos_spec()
+    assert mutant.load_spec() == base.load_spec()
+    assert mutant.net_spec() == base.net_spec()
+    # distinct attempts on one stream draw distinct re-keys
+    again = dataclasses.replace(base, mut=(("faults", 2),))
+    assert again.fault_spec().seed != mutant.fault_spec().seed
+    # and the schedule digest moves with the mutated stream
+    assert mutant.schedule_digest() != base.schedule_digest()
+
+
+def test_hunt_grid_is_deterministic_and_arms_both_fault_classes():
+    a = hunt_grid(18, 24)
+    b = hunt_grid(18, 24)
+    assert [s.canonical() for s in a] == [s.canonical() for s in b]
+    # the hunt pool draws from its OWN streams: a hunt and a sweep
+    # under one campaign seed never share grammar randomness
+    sweep = scenario_grid(18, 24)
+    assert a[0].seed != sweep[0].seed
+    # the wider structural range actually arms the ISSUE 18 classes
+    assert any(s.announce_restarts > 0 for s in a)
+    assert any(s.forges > 0 for s in a)
+    # every draw satisfies the spec's own validation (construction
+    # would have raised), and armed forgers always have a sync victim
+    assert all(s.kills or s.announce_restarts
+               for s in a if s.forges)
+    with pytest.raises(ValueError):
+        hunt_grid(18, 0)
+
+
+def test_signatures_and_near_miss_streams():
+    spec = ScenarioSpec(seed=5, replicas=4, requests=16, swaps=1,
+                        kills=1, announce_restarts=1, forges=1,
+                        faults=0.3, mut=(("events", 1),))
+    predicted = predicted_signature(spec)
+    assert {"announce_restart", "forge", "mutant", "kill", "resync",
+            "swap", "faults"} <= predicted
+    assert predicted <= set(COVERAGE_AXES)
+    # the actual signature is count-driven + armed grammars, sorted
+    v = Verdict(spec=spec.canonical(), digest="d", violations=(),
+                counts={"kills": 1, "restarts": 1, "resyncs": 1,
+                        "forge_rejected": 1, "swaps_applied": 1})
+    sig = actual_signature(spec, v)
+    assert sig == tuple(sorted(sig))
+    assert "forge_rejected" in sig and "announce_restart" in sig
+    # near-miss: a resync beside an announce perturbs "events"; a
+    # fired defense perturbs "net"
+    assert near_miss_streams(spec, v) == ("events", "net")
+    quiet = Verdict(spec=spec.canonical(), digest="d", violations=(),
+                    counts={"kills": 1})
+    assert near_miss_streams(spec, quiet) == ()
+    # a violation is a FAILURE, not a near-miss — it goes to the
+    # shrinker, never back into the mutation queue
+    red = Verdict(spec=spec.canonical(), digest="d",
+                  violations=(Violation("RECOMPILE", "x"),),
+                  counts={"resyncs": 1, "forge_rejected": 1,
+                          "swaps_applied": 1})
+    assert near_miss_streams(spec, red) == ()
+
+
+def test_search_same_seed_same_artifact_modulo_wall():
+    a = run_search(4, 3, oracle=PropertyOracle())
+    b = run_search(4, 3, oracle=PropertyOracle())
+    assert a["digest"] == b["digest"]
+    a.pop("wall_s"), b.pop("wall_s")
+    assert a == b
+    assert a["schema"] == "CAMPAIGN.v2"
+    assert a["scenarios"] == 3 and a["failures"] == 0
+    for v in a["verdicts"]:
+        assert v["origin"]["kind"] in ("grid", "mutation")
+        assert v["signature"] == sorted(v["signature"])
+
+
+def test_search_artifact_validates_under_v2_rules():
+    art = run_search(4, 3, oracle=PropertyOracle())
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import check_bench_schema as cbs
+    assert cbs.check_campaign_artifact(art, "CAMPAIGN_x.json") == []
+    # the digest is a pure function of the recorded hunt facts
+    entries = [(Verdict(spec=v["spec"], digest=v["digest"],
+                        violations=(), counts={}),
+                v["origin"], tuple(v["signature"]))
+               for v in art["verdicts"]]
+    assert search_digest(entries) == art["digest"]
+
+
+def test_committed_hunt_artifact_matches_regeneration():
+    # CAMPAIGN_r18.json is not a snapshot of a machine that once
+    # existed: the same seed re-derives the whole hunt — scheduling
+    # order, mutation lineage, coverage tally — bitwise (modulo wall)
+    path = os.path.join(REPO, "CAMPAIGN_r18.json")
+    committed = json.load(open(path))
+    assert committed["schema"] == "CAMPAIGN.v2"
+    assert committed["failures"] == 0
+    art = run_search(committed["seed"], committed["budget"],
+                     oracle=PropertyOracle())
+    assert art["digest"] == committed["digest"]
+    assert art["verdicts"] == committed["verdicts"]
+    assert art["coverage"] == committed["coverage"]
+    # the acceptance floor: the hunt actually hunted — at least one
+    # committed scenario descends from a near-miss mutation, and both
+    # ISSUE 18 fault classes fired with the defense observing them
+    origins = [v["origin"]["kind"] for v in committed["verdicts"]]
+    assert "mutation" in origins
+    for axis in ("announce_restart", "forge", "forge_rejected",
+                 "resync"):
+        assert committed["coverage"].get(axis, 0) > 0, axis
+    # mutation lineage is well-founded: parents ran earlier
+    for i, v in enumerate(committed["verdicts"]):
+        if v["origin"]["kind"] == "mutation":
+            assert 0 <= v["origin"]["parent"] < i
+
+
 # -- the nightly sweep -------------------------------------------------
 
 @pytest.mark.slow
 @pytest.mark.campaign_sweep
 def test_campaign_sweep_200_scenarios():
-    """The nightly: >= 200 composed scenarios under one seed, zero
-    invariant violations, deterministic digest (re-derived from the
-    verdict records, not re-run — the budget IS the wall-clock)."""
-    art = run_campaign(16, 200, oracle=PropertyOracle())
-    assert art["scenarios"] >= 200
+    """The nightly: >= 200 coverage-guided scenarios under one seed,
+    zero invariant violations, deterministic digest (re-derived from
+    the verdict records, not re-run — the budget IS the wall-clock).
+    ``CAMPAIGN_WALL_S`` caps the hunt's wall-clock: a capped nightly
+    may come up short only by saying so (``truncated``)."""
+    wall = float(os.environ.get("CAMPAIGN_WALL_S", 0)) or None
+    art = run_search(16, 200, oracle=PropertyOracle(),
+                     wall_budget_s=wall)
+    assert art["schema"] == "CAMPAIGN.v2"
     assert art["failures"] == 0, json.dumps(
         art["violations"], indent=2)[:4000]
-    verdicts = [Verdict(spec=v["spec"], digest=v["digest"],
-                        violations=(), counts={})
-                for v in art["verdicts"]]
-    assert campaign_digest(verdicts) == art["digest"]
+    assert art["scenarios"] >= 200 or art["truncated"]
+    assert art["wall_budget_s"] == wall
+    entries = [(Verdict(spec=v["spec"], digest=v["digest"],
+                        violations=(), counts={}),
+                v["origin"], tuple(v["signature"]))
+               for v in art["verdicts"]]
+    assert search_digest(entries) == art["digest"]

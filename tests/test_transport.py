@@ -45,9 +45,10 @@ from fedamw_tpu.serving import (FailoverRouter, FrameError,
                                 NetChaosSpec, PodClientEngine,
                                 PodWorker, Replica, ServingEngine,
                                 ServingService, SocketTransport,
-                                TransportError, TransportRefused,
-                                TransportTimeout, pack_weights,
-                                resolve_net_chaos, unpack_weights)
+                                SyncTimeout, TransportError,
+                                TransportRefused, TransportTimeout,
+                                pack_weights, resolve_net_chaos,
+                                unpack_weights, weights_fingerprint)
 from fedamw_tpu.serving.chaos import (NET_CLEAN, NET_LAG,
                                       NET_PARTITION, NET_REFUSE)
 from fedamw_tpu.serving.transport import (FRAME_MAGIC, pack_batch,
@@ -953,3 +954,187 @@ def test_sync_frame_serves_live_weights_over_the_wire():
         params, rff = unpack_weights(payload)
         assert np.array_equal(np.asarray(params["w"]),
                               rows(C, seed=13))
+
+
+# -- byzantine-hardened pod sync (ISSUE 18) ----------------------------
+
+def test_sync_timeout_is_typed_and_bounds_the_handshake():
+    """A peer that ACCEPTS the connection but never answers (the
+    wedged process) must cost at most the handshake budget: the
+    per-peer exchange raises typed SyncTimeout, resync counts it and
+    moves on, and the rejoiner comes up in bounded time."""
+    wedge = socket.socket()
+    wedge.bind(("127.0.0.1", 0))
+    wedge.listen(1)  # kernel accepts; nobody ever answers
+    try:
+        ep = ("127.0.0.1", wedge.getsockname()[1])
+        eng = make_engine()
+        w = PodWorker(eng, peers=[ep])
+        with pytest.raises(SyncTimeout, match="sync peer"):
+            w._sync_one(ep, 0.2)
+        t0 = time.perf_counter()
+        assert w.resync(timeout_s=0.4) is None
+        assert time.perf_counter() - t0 < 2.0
+        assert w.sync_timeouts >= 1
+        assert isinstance(SyncTimeout("x"), TransportTimeout)
+    finally:
+        wedge.close()
+
+
+def test_stale_epoch_announce_refused_loudly():
+    """The epoch fence: an announce whose epoch is at or below the
+    last accepted one is a replay/stale broadcast — refused with a
+    permanent error frame, counted, and the installed weights are
+    untouched. Frames WITHOUT an epoch (legacy clients) install as
+    before."""
+    eng = make_engine()
+    with PodWorker(eng) as w:
+        pod = PodClientEngine([("127.0.0.1", w.port)])
+        fresh = rows(C, seed=21)
+        blob = pack_weights({"w": fresh}, None)
+        resp, _ = pod.control(
+            ("127.0.0.1", w.port),
+            {"kind": "swap", "version": 1, "epoch": 2}, blob)
+        assert resp["kind"] == "ok" and eng.version == 1
+        # replayed epoch (== last accepted): refused loudly
+        stale = pack_weights({"w": rows(C, seed=22)}, None)
+        resp, _ = pod.control(
+            ("127.0.0.1", w.port),
+            {"kind": "swap", "version": 5, "epoch": 2}, stale)
+        assert resp["kind"] == "error"
+        assert resp["transient"] is False
+        assert "stale announce epoch" in resp["error"]
+        assert eng.version == 1
+        assert np.array_equal(np.asarray(eng.params["w"]), fresh)
+        assert w.stale_refused == 1
+        # a legacy epoch-free frame still installs (byte-compat)
+        resp, _ = pod.control(
+            ("127.0.0.1", w.port),
+            {"kind": "swap", "version": 2}, stale)
+        assert resp["kind"] == "ok" and eng.version == 2
+
+
+def test_forged_fingerprint_announce_rejected():
+    """An announce whose payload does not hash to its claimed
+    fingerprint never installs — permanent error, counted."""
+    eng = make_engine()
+    with PodWorker(eng) as w:
+        pod = PodClientEngine([("127.0.0.1", w.port)])
+        before = np.asarray(eng.params["w"]).copy()
+        blob = pack_weights({"w": rows(C, seed=23)}, None)
+        resp, _ = pod.control(
+            ("127.0.0.1", w.port),
+            {"kind": "swap", "version": 7, "epoch": 9,
+             "fingerprint": "0" * 64}, blob)
+        assert resp["kind"] == "error"
+        assert resp["transient"] is False
+        assert "fingerprint mismatch" in resp["error"]
+        assert eng.version == 0
+        assert np.array_equal(np.asarray(eng.params["w"]), before)
+        assert w.forge_rejected == 1
+
+
+def test_announce_restart_race_heals_via_straggler_repass():
+    """The scripted announce-vs-restart race (the shrunk regression's
+    mechanism, deterministic): worker A is dead when the announce
+    reaches it first, restarts mid-announce (rejoining off a peer the
+    announce has NOT reached yet — so resync finds nothing newer), and
+    would be left on the old version forever. The client's straggler
+    re-pass retries failed endpoints once after the first pass and
+    lands A on the announced version — both workers agree."""
+    eng_a, eng_b = make_engine(), make_engine()
+    wa = PodWorker(eng_a, worker_id=0).start()
+    port_a = wa.port
+    with PodWorker(eng_b, worker_id=1) as wb:
+        eps = [("127.0.0.1", port_a), ("127.0.0.1", wb.port)]
+        pod = PodClientEngine(eps)
+        wa.stop()  # dead at announce time
+        restarted = []
+
+        def rejoin(ep, ok):
+            if ep == eps[0] and not ok and not restarted:
+                # restart on the SAME port, syncing from B — which
+                # has not seen the announce yet (endpoint order)
+                w2 = PodWorker(eng_a, worker_id=0, port=port_a,
+                               peers=[eps[1]]).start()
+                restarted.append(w2)
+
+        pod.on_announce = rejoin
+        try:
+            new_w = rows(C, seed=31)
+            assert pod.swap_weights({"w": new_w}) == 1
+            assert restarted, "the race script never fired"
+            assert pod.last_announce["acks"] == 2
+            assert pod.last_announce["failures"] == []
+            assert eng_a.version == eng_b.version == 1
+            assert np.array_equal(np.asarray(eng_a.params["w"]), new_w)
+        finally:
+            pod.on_announce = None
+            for w2 in restarted:
+                w2.stop()
+
+
+def test_resync_quorum_rejects_self_consistent_forger():
+    """The byzantine sync peer: serves forged weights under a claimed
+    newer version WITH a self-consistent fingerprint (content
+    verification alone cannot unmask it). The rejoiner's strict
+    -majority fingerprint quorum rejects the disagreeing reply and
+    installs the honest pod's version instead."""
+    honest_w = rows(C, seed=41)
+    honest = []
+    for i in range(3):
+        e = make_engine()
+        e.swap_weights({"w": honest_w}, version=1)
+        honest.append(PodWorker(e, worker_id=i).start())
+    liar_eng = make_engine()
+    liar_eng.swap_weights({"w": honest_w}, version=1)
+    liar = PodWorker(liar_eng, worker_id=3, forge_sync=99).start()
+    try:
+        # the forgery IS self-consistent: its reply fingerprint hashes
+        # its own (garbage) payload under the claimed version
+        with socket.create_connection(("127.0.0.1", liar.port),
+                                      timeout=5.0) as sock:
+            sock.settimeout(5.0)
+            write_frame(sock, {"kind": "sync"})
+            resp, payload = read_frame(sock, 1 << 30)
+        assert resp["version"] == 99
+        params, rff = unpack_weights(payload)
+        assert resp["fingerprint"] == weights_fingerprint(
+            params, rff, 99)
+        assert not np.array_equal(np.asarray(params["w"]), honest_w)
+        # the rejoiner: quorum of 3 honest vs 1 forged
+        peers = [("127.0.0.1", w.port) for w in honest] + [
+            ("127.0.0.1", liar.port)]
+        rejoiner = make_engine()
+        with PodWorker(rejoiner, worker_id=4, peers=peers) as w:
+            assert rejoiner.version == 1
+            assert np.array_equal(np.asarray(rejoiner.params["w"]),
+                                  honest_w)
+            assert w.forge_rejected == 1
+            assert w.resyncs == 1
+    finally:
+        for w in honest:
+            w.stop()
+        liar.stop()
+
+
+def test_resync_rejects_reply_disowning_its_payload():
+    """A reply whose fingerprint does not hash its own payload (wire
+    corruption, or a forger too lazy to re-hash) is dropped before the
+    quorum even runs."""
+
+    class _Corrupt(PodWorker):
+        def _handle_sync(self):
+            resp, blob = super()._handle_sync()
+            resp = dict(resp, version=9,
+                        fingerprint="f" * 64)  # disowns the payload
+            return resp, blob
+
+    eng = make_engine()
+    eng.swap_weights({"w": rows(C, seed=43)}, version=1)
+    with _Corrupt(eng) as bad:
+        rejoiner = make_engine()
+        w = PodWorker(rejoiner, peers=[("127.0.0.1", bad.port)])
+        with w:
+            assert rejoiner.version == 0  # nothing trusted to install
+            assert w.forge_rejected == 1

@@ -86,7 +86,15 @@ Three artifact families, three rule sets:
   well-formed, and — the committed-artifact contract — ZERO failures:
   a campaign artifact carrying violations is an unfixed bug wearing a
   green filename; the shrunk repro belongs in
-  ``campaigns/regressions/`` next to its fix.
+  ``campaigns/regressions/`` next to its fix. From schema v2 on (the
+  ISSUE 18 coverage-guided hunter), the hunt accounting is contract
+  too: a ``coverage`` axis tally of non-negative ints, a
+  ``wall_budget_s`` that is positive or honestly null, and per-verdict
+  provenance — an ``origin`` that is either a grid draw (with its pool
+  index) or a mutation (whose ``parent`` ran EARLIER in the verdict
+  sequence, with its stream and attempt), plus the ``signature`` axis
+  list the scheduler priced — so a hand-edited artifact can never wear
+  a lineage the seed would not re-derive.
 - ``SCALE_rNN.json`` — ``scale_bench.py``'s own artifact (the ISSUE 8
   cohort plane): ``schema`` in the ``SCALE.`` family, a ``platform``
   label, a non-empty ``records`` list, and — from schema v1 on — a
@@ -794,6 +802,73 @@ def check_graftlint_artifact(art: dict, name: str) -> list[str]:
     return errs
 
 
+def _check_hunt_verdict(v: dict, i: int) -> list[str]:
+    """The CAMPAIGN.v2 per-verdict provenance contract: every record
+    names where the scheduler got it (a grid draw or a mutation of an
+    EARLIER verdict) and which coverage axes it actually touched — the
+    facts the search digest hashes, so a record without them cannot be
+    replayed."""
+    errs = []
+    origin = v.get("origin")
+    if not isinstance(origin, dict):
+        errs.append("schema v2+ requires an 'origin' record (grid "
+                    "draw or mutation lineage)")
+    elif origin.get("kind") == "grid":
+        if not isinstance(origin.get("index"), int) \
+                or origin["index"] < 0:
+            errs.append("grid origin must carry its non-negative "
+                        "pool 'index'")
+    elif origin.get("kind") == "mutation":
+        parent = origin.get("parent")
+        if not isinstance(parent, int) or not 0 <= parent < i:
+            errs.append(f"mutation origin 'parent'={parent!r} must "
+                        "name an EARLIER verdict index (lineage is "
+                        "well-founded: the near-miss ran first)")
+        if not isinstance(origin.get("stream"), str) \
+                or not origin.get("stream"):
+            errs.append("mutation origin must name the re-keyed "
+                        "'stream'")
+        if not isinstance(origin.get("attempt"), int) \
+                or origin["attempt"] < 1:
+            errs.append("mutation origin 'attempt' must be a "
+                        "positive int")
+    else:
+        errs.append(f"origin kind {origin.get('kind')!r} must be "
+                    "'grid' or 'mutation'")
+    sig = v.get("signature")
+    if not isinstance(sig, list) \
+            or not all(isinstance(a, str) and a for a in sig):
+        errs.append("schema v2+ requires a 'signature' list of axis "
+                    "names (the coverage facts the digest hashes)")
+    return errs
+
+
+def _check_hunt_accounting(art: dict) -> list[str]:
+    """The CAMPAIGN.v2 top-level hunt accounting: the coverage tally
+    that steered the scheduler, and the wall budget the run was
+    honest about."""
+    errs = []
+    cov = art.get("coverage")
+    if not isinstance(cov, dict) or not cov:
+        errs.append("schema v2+ requires a non-empty 'coverage' axis "
+                    "tally (the rarity scheduler's steering state)")
+    else:
+        for axis, n in cov.items():
+            if not isinstance(n, int) or n < 0:
+                errs.append(f"coverage[{axis}]: must be a "
+                            "non-negative int")
+    if "wall_budget_s" not in art:
+        errs.append("schema v2+ requires 'wall_budget_s' (positive "
+                    "number, or null for an uncapped hunt)")
+    else:
+        wall = art["wall_budget_s"]
+        if wall is not None and (not isinstance(wall, (int, float))
+                                 or wall <= 0):
+            errs.append(f"'wall_budget_s'={wall!r} must be a positive "
+                        "number or null")
+    return errs
+
+
 def check_campaign_artifact(art: dict, name: str) -> list[str]:
     """``tools/run_campaign.py``'s CAMPAIGN.vN artifact (the scenario
     fuzzing plane)."""
@@ -803,9 +878,8 @@ def check_campaign_artifact(art: dict, name: str) -> list[str]:
         errs.append(f"schema must be in the CAMPAIGN. family, "
                     f"got {art.get('schema')!r}")
         return errs
-    try:
-        int(schema.rsplit(".v", 1)[1])
-    except (IndexError, ValueError):
+    version = _schema_version(schema)
+    if version is None:
         errs.append(f"unparseable schema version {schema!r} "
                     "(expected CAMPAIGN.vN)")
     if not isinstance(art.get("seed"), int) or art["seed"] < 0:
@@ -860,6 +934,11 @@ def check_campaign_artifact(art: dict, name: str) -> list[str]:
                             f"disagrees with codes={codes!r}")
             if not v.get("ok", True):
                 red += 1
+            if version is not None and version >= 2:
+                errs.extend(f"verdicts[{i}]: {e}"
+                            for e in _check_hunt_verdict(v, i))
+    if version is not None and version >= 2:
+        errs.extend(_check_hunt_accounting(art))
     violations = art.get("violations")
     if not isinstance(violations, list):
         errs.append("'violations' must be a list (the failing "

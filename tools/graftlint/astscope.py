@@ -79,7 +79,21 @@ HOT_PATHS = {
         # loop (every pod request crosses it; a host sync or
         # shape-keyed cache here taxes the whole pod)
         "InProcessTransport.dispatch", "SocketTransport.dispatch",
-        "PodWorker._serve_conn", "PodWorker._handle_dispatch"},
+        "PodWorker._serve_conn", "PodWorker._handle_dispatch",
+        # the ISSUE 18 byzantine-hardened sync surface: announce
+        # handling and the fingerprint-verified sync reply run per
+        # pod frame on worker serve threads, resync blocks a
+        # rejoining worker's first serve, and the client's
+        # swap-announce holds the pod-wide swap lock — a host sync
+        # or fresh jit on any of them stalls live dispatch
+        "PodClientEngine.swap_weights", "PodWorker.resync",
+        "PodWorker._handle_swap", "PodWorker._handle_sync"},
+    "scenario/search.py": {
+        # the ISSUE 18 hunt scheduler: the rarity pricing loop runs
+        # between every scenario of a (wall-budgeted) campaign — a
+        # device sync here would bill oracle wall-clock to the
+        # scheduler and skew the truncation accounting
+        "run_search"},
     "scenario/oracle.py": {
         # the ISSUE 16 property oracle: these run inside the scenario's
         # live serve leg (predict per pod dispatch, submit/event

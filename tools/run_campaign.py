@@ -1,11 +1,13 @@
 #!/usr/bin/env python
-"""Run a scenario-fuzzing campaign and write the CAMPAIGN.v1 artifact.
+"""Run a scenario-fuzzing campaign and write the CAMPAIGN artifact.
 
 Usage::
 
     python tools/run_campaign.py --seed 7 --budget 200
     python tools/run_campaign.py --seed 7 --budget 200 \
         --out CAMPAIGN_fuzz.json --regressions campaigns/regressions
+    python tools/run_campaign.py --seed 7 --budget 500 --search \
+        --wall-budget-s 900
 
 Sweeps ``--budget`` composed scenarios (all derived from ``--seed``;
 see ``fedamw_tpu.scenario``) through the property oracle on CPU,
@@ -15,13 +17,21 @@ invariant — shrinks it and drops the minimal repro into
 ``--regressions``, where the pytest collector
 (``tests/test_campaign_regressions.py``) will replay it forever.
 
+``--search`` swaps the blind grid sweep for the ISSUE 18 coverage
+-guided hunter (``run_search``): rarity-scheduled candidates,
+near-miss mutation, a ``CAMPAIGN.v2`` artifact with coverage
+accounting and mutation lineage. ``--wall-budget-s`` (or the
+``CAMPAIGN_WALL_S`` environment knob the nightly sets) bounds the
+hunt by wall-clock; the artifact is marked ``truncated`` when it
+fires.
+
 Exit status: 0 when every scenario ran clean, 1 when any violated an
 invariant (the artifact and repro files are written either way).
 
 The artifact is deterministic per seed modulo ``wall_s`` and
-``truncated``: ``--time-budget-s`` exists for CI hygiene, but a
-truncated campaign's digest covers only the scenarios that ran —
-compare digests between runs only at equal scenario counts.
+``truncated``: the time budgets exist for CI hygiene, but a truncated
+campaign's digest covers only the scenarios that ran — compare
+digests between runs only at equal scenario counts.
 """
 
 import argparse
@@ -52,23 +62,40 @@ def main(argv=None) -> int:
     ap.add_argument("--time-budget-s", type=float, default=None,
                     help="stop starting new scenarios after this many "
                          "seconds (artifact is marked truncated)")
+    ap.add_argument("--search", action="store_true",
+                    help="coverage-guided hunt (run_search, "
+                         "CAMPAIGN.v2) instead of the grid sweep")
+    ap.add_argument("--wall-budget-s", type=float, default=None,
+                    help="with --search: wall-clock hunt budget "
+                         "(defaults to the CAMPAIGN_WALL_S env var "
+                         "when set)")
     ap.add_argument("--quiet", action="store_true",
                     help="suppress the per-scenario progress lines")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     from fedamw_tpu.scenario import (PropertyOracle, ScenarioSpec,
-                                     run_campaign, write_regression)
+                                     run_campaign, run_search,
+                                     write_regression)
 
     out = args.out or os.path.join(_REPO, "CAMPAIGN_fuzz.json")
     reg_dir = args.regressions or os.path.join(_REPO, "campaigns",
                                                "regressions")
     progress = None if args.quiet else (
         lambda line: print(line, file=sys.stderr, flush=True))
-    artifact = run_campaign(
-        args.seed, args.budget, oracle=PropertyOracle(),
-        shrink_failures=not args.no_shrink,
-        time_budget_s=args.time_budget_s, progress=progress)
+    if args.search:
+        wall = args.wall_budget_s
+        if wall is None and os.environ.get("CAMPAIGN_WALL_S"):
+            wall = float(os.environ["CAMPAIGN_WALL_S"])
+        artifact = run_search(
+            args.seed, args.budget, oracle=PropertyOracle(),
+            shrink_failures=not args.no_shrink,
+            wall_budget_s=wall, progress=progress)
+    else:
+        artifact = run_campaign(
+            args.seed, args.budget, oracle=PropertyOracle(),
+            shrink_failures=not args.no_shrink,
+            time_budget_s=args.time_budget_s, progress=progress)
 
     written = []
     for failure in artifact["violations"]:
